@@ -1,0 +1,25 @@
+"""llm-d KV-cache manager, TPU-native.
+
+A TPU-first re-design of the llm-d KV-cache indexing / routing stack
+(reference: sagiahrac/llm-d-kv-cache-manager).  Two stacks:
+
+* **Indexer stack** (`kvcache`, `kvevents`, `tokenization`, `preprocessing`,
+  `metrics`, `api`): a fleet of vLLM-TPU pods emits KVEvents whenever KV
+  blocks are stored/evicted; a central Indexer ingests them into a global
+  block-hash -> {pod, tier} index and scores pods by longest resident
+  prefix for KV-cache-aware routing.
+
+* **Offload stack** (`offload`, `native`, `models`, `ops`, `parallel`): a
+  TPU-native KV-offload connector paging KV blocks between TPU HBM and
+  host/shared-storage via XLA host-offload, plus a paged-attention serving
+  model used to exercise it end-to-end.
+
+Import as ``import llm_d_kv_cache_manager_tpu as kvtpu``.
+"""
+
+__version__ = "0.1.0"
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (  # noqa: F401
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)

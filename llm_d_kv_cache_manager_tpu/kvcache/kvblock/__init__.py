@@ -1,0 +1,12 @@
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (  # noqa: F401
+    EMPTY_BLOCK_HASH,
+    Index,
+    IndexConfig,
+    PodEntry,
+    new_index,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (  # noqa: F401
+    ChunkedTokenDatabase,
+    TokenProcessor,
+    TokenProcessorConfig,
+)

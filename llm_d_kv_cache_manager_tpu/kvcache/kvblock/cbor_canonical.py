@@ -1,0 +1,87 @@
+"""Minimal canonical (deterministic) CBOR encoder for block-hash payloads.
+
+The cross-fleet block-hash contract requires bit-exact agreement with the
+reference indexer, which hashes ``FNV-64a(CBOR-canonical([parent, tokens,
+extra]))`` per chunk (reference: pkg/kvcache/kvblock/token_processor.go:94-112
+using fxamacker/cbor CanonicalEncOptions).  Only the types that can appear in
+that payload are supported: unsigned/negative integers, byte strings, text
+strings, lists, booleans and null.  Canonical form here means RFC 8949 §4.2.1
+core deterministic encoding: shortest-form integer heads, definite lengths.
+
+A nil Go slice encodes as CBOR null (fxamacker default NilContainers mode);
+callers express that by passing ``None`` rather than ``[]``.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Sequence
+
+_UINT64_MAX = 0xFFFFFFFFFFFFFFFF
+
+
+def _head(major: int, value: int) -> bytes:
+    """Encode a major type + shortest-form unsigned argument."""
+    mt = major << 5
+    if value < 24:
+        return bytes((mt | value,))
+    if value < 0x100:
+        return bytes((mt | 24, value))
+    if value < 0x10000:
+        return struct.pack(">BH", mt | 25, value)
+    if value < 0x100000000:
+        return struct.pack(">BI", mt | 26, value)
+    if value <= _UINT64_MAX:
+        return struct.pack(">BQ", mt | 27, value)
+    raise ValueError(f"integer too large for CBOR head: {value}")
+
+
+def _encode_into(item: Any, out: bytearray) -> None:
+    if item is None:
+        out.append(0xF6)
+    elif item is True:
+        out.append(0xF5)
+    elif item is False:
+        out.append(0xF4)
+    elif isinstance(item, int):
+        if item >= 0:
+            out += _head(0, item)
+        else:
+            out += _head(1, -1 - item)
+    elif isinstance(item, bytes):
+        out += _head(2, len(item))
+        out += item
+    elif isinstance(item, str):
+        raw = item.encode("utf-8")
+        out += _head(3, len(raw))
+        out += raw
+    elif isinstance(item, (list, tuple)):
+        out += _head(4, len(item))
+        for element in item:
+            _encode_into(element, out)
+    else:
+        raise TypeError(f"unsupported CBOR type: {type(item)!r}")
+
+
+def encode_canonical(item: Any) -> bytes:
+    """Encode ``item`` as deterministic CBOR bytes."""
+    out = bytearray()
+    _encode_into(item, out)
+    return bytes(out)
+
+
+def encode_hash_payload(
+    parent: int, tokens: Sequence[int] | None, extra: Any
+) -> bytes:
+    """Encode the 3-element ``[parent, tokens, extra]`` block-hash payload."""
+    out = bytearray()
+    out += _head(4, 3)
+    _encode_into(parent, out)
+    if tokens is None:
+        out.append(0xF6)
+    else:
+        out += _head(4, len(tokens))
+        for token in tokens:
+            out += _head(0, token)
+    _encode_into(extra, out)
+    return bytes(out)

@@ -1,0 +1,130 @@
+"""Default in-memory index: two bounded LRU maps.
+
+``request_key -> PodCache`` (an LRU of PodEntry) plus
+``engine_key -> request_key`` for evictions, mirroring the reference's
+two-level design (pkg/kvcache/kvblock/in_memory.go:105-270) with a single
+lock per pod-cache and atomic put-if-absent instead of Go's double-checked
+insert.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Set
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    Index,
+    InMemoryIndexConfig,
+    PodEntry,
+)
+from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
+
+
+class _PodCache:
+    """Bounded recency set of PodEntry for one block key."""
+
+    __slots__ = ("entries", "lock")
+
+    def __init__(self, capacity: int) -> None:
+        self.entries: LRUCache[PodEntry, None] = LRUCache(capacity)
+        self.lock = threading.Lock()
+
+    def add_all(self, entries: Sequence[PodEntry]) -> None:
+        with self.lock:
+            for entry in entries:
+                self.entries.put(entry, None)
+
+    def remove_all(self, entries: Sequence[PodEntry]) -> bool:
+        """Remove entries; return True if the cache is now empty."""
+        with self.lock:
+            for entry in entries:
+                self.entries.remove(entry)
+            return len(self.entries) == 0
+
+    def snapshot(self) -> List[PodEntry]:
+        return self.entries.keys()
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+class InMemoryIndex(Index):
+    def __init__(self, config: Optional[InMemoryIndexConfig] = None) -> None:
+        self.config = config or InMemoryIndexConfig()
+        self._data: LRUCache[int, _PodCache] = LRUCache(self.config.size)
+        self._engine_to_request: LRUCache[int, int] = LRUCache(self.config.size)
+
+    def lookup(
+        self,
+        request_keys: Sequence[int],
+        pod_identifier_set: Optional[Set[str]] = None,
+    ) -> Dict[int, List[PodEntry]]:
+        if not request_keys:
+            raise ValueError("no request keys provided for lookup")
+
+        pods_per_key: Dict[int, List[PodEntry]] = {}
+        for key in request_keys:
+            pod_cache = self._data.get(key)
+            if pod_cache is None:
+                continue
+            pods = pod_cache.snapshot()
+            if not pods:
+                # The prefix chain is broken here for every pod: stop.
+                return pods_per_key
+            if pod_identifier_set:
+                pods = [
+                    p for p in pods if p.pod_identifier in pod_identifier_set
+                ]
+            if pods:
+                pods_per_key[key] = pods
+        return pods_per_key
+
+    def add(
+        self,
+        engine_keys: Sequence[int],
+        request_keys: Sequence[int],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        if not engine_keys or not request_keys or not entries:
+            raise ValueError("no keys or entries provided for add")
+        if len(engine_keys) != len(request_keys):
+            raise ValueError(
+                "engine keys and request keys length mismatch: "
+                f"{len(engine_keys)} != {len(request_keys)}"
+            )
+
+        for engine_key, request_key in zip(engine_keys, request_keys):
+            self._engine_to_request.put(engine_key, request_key)
+            pod_cache = self._data.get(request_key)
+            if pod_cache is None:
+                pod_cache = self._data.put_if_absent(
+                    request_key, _PodCache(self.config.pod_cache_size)
+                )
+            pod_cache.add_all(entries)
+
+    def evict(self, engine_key: int, entries: Sequence[PodEntry]) -> None:
+        if not entries:
+            raise ValueError("no entries provided for eviction")
+
+        request_key = self._engine_to_request.get(engine_key)
+        if request_key is None:
+            return
+        pod_cache = self._data.get(request_key)
+        if pod_cache is None:
+            self._engine_to_request.remove(engine_key)
+            return
+
+        if pod_cache.remove_all(entries):
+            # Re-check under the current resident cache to narrow the race
+            # with a concurrent add; worst case an empty cache lingers until
+            # LRU pressure clears it.
+            current = self._data.get(request_key)
+            if current is not None and len(current) == 0:
+                self._data.remove(request_key)
+                self._engine_to_request.remove(engine_key)
+
+    def get_request_key(self, engine_key: int) -> int:
+        request_key = self._engine_to_request.get(engine_key)
+        if request_key is None:
+            raise KeyError(f"engine key not found: {engine_key:#x}")
+        return request_key

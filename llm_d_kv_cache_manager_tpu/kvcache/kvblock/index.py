@@ -1,0 +1,145 @@
+"""KV-block index contract and backend factory.
+
+The index answers one question fast: *which pods hold which KV blocks, on
+which memory tier?*  It is written by the KVEvents ingestion pool and read
+by the scoring path.
+
+Dual-key design (the subtle core, reference pkg/kvcache/kvblock/index.go and
+pool.go:272-292): *engine keys* are whatever hashes an engine pod reports —
+possibly seeded differently or sha256-truncated — while *request keys* are
+recomputed locally from the event's token IDs with the indexer's own hash
+chain.  Lookups from prompts produce request keys, so routing works
+regardless of per-engine hash configuration; the engine->request mapping
+exists so evictions (which carry engine keys) can find the entry.
+
+TPU tier vocabulary: events from TPU pods carry ``Medium`` in
+{"hbm", "host", "shared_storage"}; GPU-era names ("gpu", "cpu") are accepted
+for wire compatibility and mapped by the scorer's weight table.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set
+
+EMPTY_BLOCK_HASH = 0
+
+
+@dataclass(frozen=True)
+class PodEntry:
+    """A (pod, device-tier) pair holding some KV block."""
+
+    pod_identifier: str
+    device_tier: str
+
+    def __str__(self) -> str:
+        return f"{self.pod_identifier}@{self.device_tier}"
+
+
+class Index(ABC):
+    """Pluggable KV-block index backend."""
+
+    @abstractmethod
+    def lookup(
+        self,
+        request_keys: Sequence[int],
+        pod_identifier_set: Optional[Set[str]] = None,
+    ) -> Dict[int, List[PodEntry]]:
+        """Return pods per key, filtered to ``pod_identifier_set`` if given.
+
+        Keys absent from the index are simply missing from the result; a key
+        present with an empty pod set terminates the scan early (the prefix
+        chain is broken there for every pod).
+        """
+
+    @abstractmethod
+    def add(
+        self,
+        engine_keys: Sequence[int],
+        request_keys: Sequence[int],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        """Record that ``entries`` hold the blocks named by the key pairs."""
+
+    @abstractmethod
+    def evict(self, engine_key: int, entries: Sequence[PodEntry]) -> None:
+        """Remove ``entries`` from the block named by ``engine_key``."""
+
+    @abstractmethod
+    def get_request_key(self, engine_key: int) -> int:
+        """Map an engine key to its request key.
+
+        Raises ``KeyError`` if the mapping is missing (e.g. already
+        evicted).
+        """
+
+
+@dataclass
+class InMemoryIndexConfig:
+    # Maximum number of block keys resident; TODO memory-based sizing.
+    size: int = 100_000_000
+    # Maximum pod entries tracked per key.
+    pod_cache_size: int = 10
+
+
+@dataclass
+class CostAwareIndexConfig:
+    # Approximate memory budget for the index, in bytes (default 2 GiB).
+    max_cost_bytes: int = 2 * 1024 * 1024 * 1024
+    pod_cache_size: int = 10
+
+
+@dataclass
+class RedisIndexConfig:
+    address: str = "127.0.0.1:6379"
+    # "redis" or "valkey"; valkey:// URLs are rewritten to redis:// with the
+    # same host/port.
+    flavor: str = "redis"
+
+
+@dataclass
+class IndexConfig:
+    """Backend selection; priority cost-aware > redis > in-memory
+    (reference: kvblock/index.go:59-105)."""
+
+    in_memory_config: Optional[InMemoryIndexConfig] = field(
+        default_factory=InMemoryIndexConfig
+    )
+    cost_aware_config: Optional[CostAwareIndexConfig] = None
+    redis_config: Optional[RedisIndexConfig] = None
+    enable_metrics: bool = False
+
+
+def new_index(config: Optional[IndexConfig] = None) -> Index:
+    """Build the configured index backend, optionally metrics-wrapped."""
+    if config is None:
+        config = IndexConfig()
+
+    index: Index
+    if config.cost_aware_config is not None:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+            CostAwareMemoryIndex,
+        )
+
+        index = CostAwareMemoryIndex(config.cost_aware_config)
+    elif config.redis_config is not None:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+            RedisIndex,
+        )
+
+        index = RedisIndex(config.redis_config)
+    else:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+            InMemoryIndex,
+        )
+
+        index = InMemoryIndex(config.in_memory_config)
+
+    if config.enable_metrics:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import (
+            InstrumentedIndex,
+        )
+
+        index = InstrumentedIndex(index)
+    return index

@@ -1,0 +1,63 @@
+"""Metrics decorator for any Index backend.
+
+Decorator-pattern instrumentation emitting admissions / evictions / lookup
+counters and a lookup-latency histogram, plus the per-lookup max-hits-per-pod
+gauge the scorer's telemetry relies on (capability parity:
+pkg/kvcache/kvblock/instrumented_index.go).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Set
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, PodEntry
+from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+
+class InstrumentedIndex(Index):
+    def __init__(self, inner: Index) -> None:
+        self._inner = inner
+
+    @property
+    def inner(self) -> Index:
+        return self._inner
+
+    def lookup(
+        self,
+        request_keys: Sequence[int],
+        pod_identifier_set: Optional[Set[str]] = None,
+    ) -> Dict[int, List[PodEntry]]:
+        METRICS.index_lookup_requests.inc()
+        start = time.perf_counter()
+        try:
+            result = self._inner.lookup(request_keys, pod_identifier_set)
+        finally:
+            METRICS.index_lookup_latency.observe(time.perf_counter() - start)
+        if result:
+            METRICS.index_lookup_hits.inc()
+            hits_per_pod: Dict[str, int] = {}
+            for pods in result.values():
+                for pod in pods:
+                    hits_per_pod[pod.pod_identifier] = (
+                        hits_per_pod.get(pod.pod_identifier, 0) + 1
+                    )
+            if hits_per_pod:
+                METRICS.index_max_pod_hits.inc(max(hits_per_pod.values()))
+        return result
+
+    def add(
+        self,
+        engine_keys: Sequence[int],
+        request_keys: Sequence[int],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        self._inner.add(engine_keys, request_keys, entries)
+        METRICS.index_admissions.inc(len(request_keys))
+
+    def evict(self, engine_key: int, entries: Sequence[PodEntry]) -> None:
+        self._inner.evict(engine_key, entries)
+        METRICS.index_evictions.inc()
+
+    def get_request_key(self, engine_key: int) -> int:
+        return self._inner.get_request_key(engine_key)

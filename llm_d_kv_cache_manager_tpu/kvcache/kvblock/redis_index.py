@@ -1,0 +1,263 @@
+"""Distributed index backed by Redis / Valkey.
+
+Capability parity with the reference's Redis backend
+(pkg/kvcache/kvblock/redis.go): the shared schema is
+
+* ``<request_key>``          -> Redis hash; fields are ``"pod@tier"``
+* ``engine:<engine_key>``    -> string holding the request key
+
+Lookups pipeline one ``HKEYS`` per block key in a single round trip; adds
+pipeline ``HSET`` + ``SET``; evictions remove fields and prune empty hashes.
+Valkey endpoints (``valkey://``) speak the same protocol and are accepted.
+
+The image ships no redis-py, so this module carries a deliberately small
+RESP2 client (sockets + pipelining) — the indexer only needs six commands.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    Index,
+    PodEntry,
+    RedisIndexConfig,
+)
+
+
+class RespError(RuntimeError):
+    """A server-side error reply (``-ERR ...``)."""
+
+
+class RespClient:
+    """Minimal RESP2 client with pipelining and transparent reconnect."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0) -> None:
+        self._host = host
+        self._port = port
+        self._timeout = timeout
+        self._sock = None
+        self._reader = None
+        self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        self._reader = self._sock.makefile("rb")
+
+    def close(self) -> None:
+        if self._reader is not None:
+            try:
+                self._reader.close()
+            except OSError:
+                pass
+            self._reader = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    @staticmethod
+    def _encode(command: Sequence) -> bytes:
+        parts = [b"*%d\r\n" % len(command)]
+        for arg in command:
+            if isinstance(arg, str):
+                arg = arg.encode()
+            elif isinstance(arg, int):
+                arg = str(arg).encode()
+            parts.append(b"$%d\r\n%s\r\n" % (len(arg), arg))
+        return b"".join(parts)
+
+    def _read_reply(self):
+        """Read one reply; server error replies are *returned* as RespError
+        instances (not raised) so a pipeline never desyncs the stream."""
+        line = self._reader.readline()
+        if not line:
+            raise ConnectionError("connection closed by server")
+        kind, payload = line[:1], line[1:-2]
+        if kind == b"+":
+            return payload.decode()
+        if kind == b"-":
+            return RespError(payload.decode())
+        if kind == b":":
+            return int(payload)
+        if kind == b"$":
+            length = int(payload)
+            if length == -1:
+                return None
+            data = self._reader.read(length + 2)
+            if len(data) != length + 2:
+                raise ConnectionError("short read from server")
+            return data[:-2]
+        if kind == b"*":
+            count = int(payload)
+            if count == -1:
+                return None
+            return [self._read_reply() for _ in range(count)]
+        raise ConnectionError(f"unknown RESP type: {kind!r}")
+
+    def execute(self, *command):
+        return self.pipeline([command])[0]
+
+    def pipeline(self, commands: Iterable[Sequence]) -> List:
+        """Send all commands, read all replies; raise the first server error
+        only after the stream is fully drained.  On transport errors the
+        connection is torn down and retried once on a fresh socket."""
+        commands = list(commands)
+        if not commands:
+            return []
+        payload = b"".join(self._encode(c) for c in commands)
+        with self._lock:
+            replies = self._round_trip_locked(payload, len(commands))
+        for reply in replies:
+            if isinstance(reply, RespError):
+                raise reply
+        return replies
+
+    def _round_trip_locked(self, payload: bytes, count: int) -> List:
+        for attempt in (0, 1):
+            try:
+                if self._sock is None:
+                    self._connect()
+                self._sock.sendall(payload)
+                return [self._read_reply() for _ in range(count)]
+            except (OSError, ConnectionError):
+                self.close()
+                if attempt == 1:
+                    raise
+        raise AssertionError("unreachable")
+
+
+def _parse_address(address: str) -> Tuple[str, int]:
+    address = address.strip()
+    if address.startswith("rediss://"):
+        raise ValueError(
+            "rediss:// (TLS) endpoints are not supported by the built-in "
+            "RESP client; terminate TLS in front of the indexer instead"
+        )
+    for scheme in ("redis://", "valkey://"):
+        if address.startswith(scheme):
+            address = address[len(scheme):]
+            break
+    address = address.split("/", 1)[0]
+    if "@" in address:
+        raise ValueError(
+            "credentials in the redis address are not supported (AUTH is "
+            "not implemented); use an unauthenticated endpoint"
+        )
+    host, _, port = address.partition(":")
+    return host or "127.0.0.1", int(port or 6379)
+
+
+_ENGINE_PREFIX = "engine:"
+
+
+class RedisIndex(Index):
+    def __init__(
+        self,
+        config: Optional[RedisIndexConfig] = None,
+        client: Optional[RespClient] = None,
+    ) -> None:
+        self.config = config or RedisIndexConfig()
+        if client is None:
+            host, port = _parse_address(self.config.address)
+            client = RespClient(host, port)
+        self._client = client
+
+    @staticmethod
+    def _field(entry: PodEntry) -> str:
+        return f"{entry.pod_identifier}@{entry.device_tier}"
+
+    @staticmethod
+    def _parse_field(field: bytes) -> Optional[PodEntry]:
+        text = field.decode()
+        pod, sep, tier = text.rpartition("@")
+        if not sep:
+            return None
+        return PodEntry(pod_identifier=pod, device_tier=tier)
+
+    def lookup(
+        self,
+        request_keys: Sequence[int],
+        pod_identifier_set: Optional[Set[str]] = None,
+    ) -> Dict[int, List[PodEntry]]:
+        if not request_keys:
+            raise ValueError("no request keys provided for lookup")
+        replies = self._client.pipeline(
+            [("HKEYS", str(key)) for key in request_keys]
+        )
+        result: Dict[int, List[PodEntry]] = {}
+        for key, fields in zip(request_keys, replies):
+            if not fields:
+                continue
+            pods = []
+            for field in fields:
+                entry = self._parse_field(field)
+                if entry is None:
+                    continue
+                if (
+                    pod_identifier_set
+                    and entry.pod_identifier not in pod_identifier_set
+                ):
+                    continue
+                pods.append(entry)
+            if pods:
+                result[key] = pods
+        return result
+
+    def add(
+        self,
+        engine_keys: Sequence[int],
+        request_keys: Sequence[int],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        if not engine_keys or not request_keys or not entries:
+            raise ValueError("no keys or entries provided for add")
+        if len(engine_keys) != len(request_keys):
+            raise ValueError("engine/request key length mismatch")
+        commands: List[Sequence] = []
+        for engine_key, request_key in zip(engine_keys, request_keys):
+            hset: List = ["HSET", str(request_key)]
+            for entry in entries:
+                hset += [self._field(entry), "1"]
+            commands.append(hset)
+            commands.append(
+                ("SET", f"{_ENGINE_PREFIX}{engine_key}", str(request_key))
+            )
+        self._client.pipeline(commands)
+
+    def evict(self, engine_key: int, entries: Sequence[PodEntry]) -> None:
+        if not entries:
+            raise ValueError("no entries provided for eviction")
+        request_key_raw = self._client.execute(
+            "GET", f"{_ENGINE_PREFIX}{engine_key}"
+        )
+        if request_key_raw is None:
+            return
+        request_key = request_key_raw.decode()
+        hdel: List = ["HDEL", request_key]
+        hdel += [self._field(entry) for entry in entries]
+        _, remaining = self._client.pipeline(
+            [hdel, ("HLEN", request_key)]
+        )
+        if remaining == 0:
+            # Benign race window with a concurrent add, as in the reference's
+            # Lua prune; an empty hash left behind is harmless.
+            self._client.pipeline(
+                [
+                    ("DEL", request_key),
+                    ("DEL", f"{_ENGINE_PREFIX}{engine_key}"),
+                ]
+            )
+
+    def get_request_key(self, engine_key: int) -> int:
+        raw = self._client.execute("GET", f"{_ENGINE_PREFIX}{engine_key}")
+        if raw is None:
+            raise KeyError(f"engine key not found: {engine_key:#x}")
+        return int(raw.decode())

@@ -1,0 +1,95 @@
+"""Pod scoring: longest consecutive resident prefix, tier-weighted.
+
+Semantics follow the reference scorer (pkg/kvcache/kvblock_scorer.go:108-151):
+starting from block 0, a pod accrues score while it appears for every
+consecutive block key; the per-block increment is the maximum tier weight
+among the pod's entries for that key.  Pods missing from block 0 score 0.
+
+TPU tier weights default to HBM > host DRAM > shared storage, with the
+GPU-era names accepted as aliases so mixed fleets and recorded event streams
+keep scoring correctly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Sequence
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
+
+LONGEST_PREFIX_MATCH = "longest-prefix-match"
+
+
+@dataclass(frozen=True)
+class TierConfig:
+    """One device tier and its scoring weight."""
+
+    name: str
+    weight: float
+
+
+def default_tier_configs() -> List[TierConfig]:
+    """TPU memory hierarchy weights (capability parity: pkg/kvcache/
+    backend.go:19-31, which weighted gpu=1.0 > cpu=0.8)."""
+    return [
+        TierConfig("hbm", 1.0),
+        TierConfig("host", 0.8),
+        TierConfig("shared_storage", 0.5),
+        # GPU-era aliases for wire compatibility with existing fleets.
+        TierConfig("gpu", 1.0),
+        TierConfig("cpu", 0.8),
+    ]
+
+
+@dataclass
+class ScorerConfig:
+    scoring_strategy: str = LONGEST_PREFIX_MATCH
+    tier_configs: List[TierConfig] = field(default_factory=default_tier_configs)
+
+
+class LongestPrefixScorer:
+    def __init__(self, tier_weights: Mapping[str, float]) -> None:
+        self.tier_weights = dict(tier_weights)
+
+    def _max_weight(self, entries: Sequence[PodEntry], pod_id: str) -> float:
+        best = 0.0
+        for entry in entries:
+            if entry.pod_identifier != pod_id:
+                continue
+            weight = self.tier_weights.get(entry.device_tier, 1.0)
+            if weight > best:
+                best = weight
+        return best
+
+    def score(
+        self,
+        keys: Sequence[int],
+        key_to_pods: Mapping[int, Sequence[PodEntry]],
+    ) -> Dict[str, float]:
+        if not keys:
+            return {}
+
+        first_pods = key_to_pods.get(keys[0], ())
+        active = {p.pod_identifier for p in first_pods}
+        scores: Dict[str, float] = {
+            pod: self._max_weight(first_pods, pod) for pod in active
+        }
+
+        for key in keys[1:]:
+            if not active:
+                break
+            pods = key_to_pods.get(key, ())
+            active &= {p.pod_identifier for p in pods}
+            for pod in active:
+                scores[pod] += self._max_weight(pods, pod)
+        return scores
+
+
+def new_scorer(config: ScorerConfig) -> LongestPrefixScorer:
+    if config.scoring_strategy != LONGEST_PREFIX_MATCH:
+        raise ValueError(
+            f"unsupported scoring strategy: {config.scoring_strategy}"
+        )
+    return LongestPrefixScorer(
+        {tier.name: tier.weight for tier in config.tier_configs}
+    )

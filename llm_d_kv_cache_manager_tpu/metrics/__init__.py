@@ -1,0 +1,5 @@
+from llm_d_kv_cache_manager_tpu.metrics.collector import (  # noqa: F401
+    METRICS,
+    KVCacheMetrics,
+    start_metrics_logging,
+)

@@ -1,0 +1,116 @@
+"""Prometheus metrics for the indexer stack.
+
+Capability parity with pkg/kvcache/metrics/collector.go: index
+admissions/evictions/lookup counters, lookup-latency histogram, per-lookup
+max-pod-hit counter, tokenization latency/token counters labeled by backend,
+and a periodic "metrics beat" logger.  Exposed through a dedicated registry
+so embedding applications can mount ``/metrics`` wherever they like.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Histogram,
+    generate_latest,
+)
+
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("metrics")
+
+_NAMESPACE = "kvtpu"
+
+
+class KVCacheMetrics:
+    def __init__(self, registry: Optional[CollectorRegistry] = None) -> None:
+        self.registry = registry or CollectorRegistry()
+        self.index_admissions = Counter(
+            f"{_NAMESPACE}_kvcache_index_admissions_total",
+            "Number of KV-block keys admitted into the index.",
+            registry=self.registry,
+        )
+        self.index_evictions = Counter(
+            f"{_NAMESPACE}_kvcache_index_evictions_total",
+            "Number of KV-block eviction operations applied to the index.",
+            registry=self.registry,
+        )
+        self.index_lookup_requests = Counter(
+            f"{_NAMESPACE}_kvcache_index_lookup_requests_total",
+            "Number of index lookups served.",
+            registry=self.registry,
+        )
+        self.index_lookup_hits = Counter(
+            f"{_NAMESPACE}_kvcache_index_lookup_hits_total",
+            "Number of index lookups that returned at least one pod.",
+            registry=self.registry,
+        )
+        self.index_max_pod_hits = Counter(
+            f"{_NAMESPACE}_kvcache_index_max_pod_hit_count_total",
+            "Sum over lookups of the max per-pod hit count.",
+            registry=self.registry,
+        )
+        self.index_lookup_latency = Histogram(
+            f"{_NAMESPACE}_kvcache_index_lookup_latency_seconds",
+            "Latency of index lookups.",
+            registry=self.registry,
+            buckets=(
+                0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+            ),
+        )
+        self.tokenization_latency = Histogram(
+            f"{_NAMESPACE}_tokenization_latency_seconds",
+            "Latency of tokenization calls by backend.",
+            ("tokenizer",),
+            registry=self.registry,
+        )
+        self.tokenization_tokens = Counter(
+            f"{_NAMESPACE}_tokenization_tokens_total",
+            "Tokens produced by tokenization calls by backend.",
+            ("tokenizer",),
+            registry=self.registry,
+        )
+        self.offload_bytes = Counter(
+            f"{_NAMESPACE}_offload_bytes_total",
+            "Bytes moved by the offload engine by direction.",
+            ("direction",),
+            registry=self.registry,
+        )
+        self.offload_jobs = Counter(
+            f"{_NAMESPACE}_offload_jobs_total",
+            "Offload jobs completed by direction and status.",
+            ("direction", "status"),
+            registry=self.registry,
+        )
+
+    def exposition(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+# Process-wide default instance; modules import this rather than plumbing a
+# registry through every constructor.
+METRICS = KVCacheMetrics()
+
+
+def start_metrics_logging(interval_seconds: float = 60.0) -> threading.Event:
+    """Log a periodic one-line metrics beat; returns a stop event."""
+    stop = threading.Event()
+
+    def beat() -> None:
+        while not stop.wait(interval_seconds):
+            logger.info(
+                "metrics beat: admissions=%d evictions=%d lookups=%d hits=%d",
+                METRICS.index_admissions._value.get(),
+                METRICS.index_evictions._value.get(),
+                METRICS.index_lookup_requests._value.get(),
+                METRICS.index_lookup_hits._value.get(),
+            )
+
+    thread = threading.Thread(target=beat, name="kvtpu-metrics-beat", daemon=True)
+    thread.start()
+    return stop

@@ -1,0 +1,1 @@
+from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache  # noqa: F401

@@ -1,0 +1,116 @@
+"""Thread-safe LRU cache used by the index and prefix-store backends.
+
+Capability parity with the hashicorp/golang-lru caches the reference builds
+on (reference: pkg/kvcache/kvblock/in_memory.go:24,
+pkg/tokenization/prefixstore/lru_store.go:26) — but implemented on
+``OrderedDict`` with a single lock, which is the idiomatic CPython shape.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Generic, Hashable, Iterator, Optional, Tuple, TypeVar
+
+K = TypeVar("K", bound=Hashable)
+V = TypeVar("V")
+
+_MISSING = object()
+
+
+class LRUCache(Generic[K, V]):
+    """A bounded mapping that evicts the least-recently-used entry.
+
+    ``get`` and ``put`` both refresh recency.  All operations are O(1) and
+    thread-safe.  An optional ``on_evict`` callback observes capacity
+    evictions (not explicit removals).
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        on_evict: Optional[Callable[[K, V], None]] = None,
+    ) -> None:
+        if capacity <= 0:
+            raise ValueError(f"LRU capacity must be positive, got {capacity}")
+        self._capacity = capacity
+        self._data: "OrderedDict[K, V]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._on_evict = on_evict
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def __contains__(self, key: K) -> bool:
+        with self._lock:
+            return key in self._data
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def get(self, key: K, default: Optional[V] = None) -> Optional[V]:
+        with self._lock:
+            value = self._data.get(key, _MISSING)
+            if value is _MISSING:
+                return default
+            self._data.move_to_end(key)
+            return value  # type: ignore[return-value]
+
+    def peek(self, key: K, default: Optional[V] = None) -> Optional[V]:
+        """Read without refreshing recency."""
+        with self._lock:
+            value = self._data.get(key, _MISSING)
+            return default if value is _MISSING else value  # type: ignore[return-value]
+
+    def put(self, key: K, value: V) -> None:
+        evicted: Optional[Tuple[K, V]] = None
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+            self._data[key] = value
+            if len(self._data) > self._capacity:
+                evicted = self._data.popitem(last=False)
+        if evicted is not None and self._on_evict is not None:
+            self._on_evict(*evicted)
+
+    def put_if_absent(self, key: K, value: V) -> V:
+        """Insert ``value`` unless ``key`` exists; return the resident value.
+
+        The atomic check-and-set the reference approximates with
+        double-checked locking (in_memory.go:183-197) is a single locked
+        operation here.
+        """
+        evicted: Optional[Tuple[K, V]] = None
+        with self._lock:
+            resident = self._data.get(key, _MISSING)
+            if resident is not _MISSING:
+                self._data.move_to_end(key)
+                result = resident
+            else:
+                self._data[key] = value
+                result = value
+                if len(self._data) > self._capacity:
+                    evicted = self._data.popitem(last=False)
+        if evicted is not None and self._on_evict is not None:
+            self._on_evict(*evicted)
+        return result  # type: ignore[return-value]
+
+    def remove(self, key: K) -> bool:
+        with self._lock:
+            return self._data.pop(key, _MISSING) is not _MISSING
+
+    def keys(self) -> list:
+        """Snapshot of keys, least-recently-used first."""
+        with self._lock:
+            return list(self._data.keys())
+
+    def items(self) -> Iterator[Tuple[K, V]]:
+        with self._lock:
+            snapshot = list(self._data.items())
+        return iter(snapshot)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()

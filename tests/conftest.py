@@ -1,0 +1,16 @@
+"""Test harness configuration.
+
+Tests run on CPU with a virtual 8-device platform so multi-chip sharding
+compiles and executes without TPU hardware.  These env vars must be set
+before JAX is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("TOKENIZERS_PARALLELISM", "false")

@@ -1,0 +1,124 @@
+"""In-process fake Redis speaking enough RESP2 for the RedisIndex backend.
+
+Test-only stand-in following the reference's miniredis pattern
+(pkg/kvcache/kvblock/redis_test.go:29-45): no real server required.
+Supports HSET / HKEYS / HDEL / HLEN / SET / GET / DEL / PING / FLUSHALL.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Dict
+
+
+class _State:
+    def __init__(self) -> None:
+        self.strings: Dict[bytes, bytes] = {}
+        self.hashes: Dict[bytes, Dict[bytes, bytes]] = {}
+        self.lock = threading.Lock()
+
+
+def _bulk(data) -> bytes:
+    if data is None:
+        return b"$-1\r\n"
+    if isinstance(data, str):
+        data = data.encode()
+    return b"$%d\r\n%s\r\n" % (len(data), data)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        state: _State = self.server.state  # type: ignore[attr-defined]
+        while True:
+            try:
+                command = self._read_command()
+            except (ConnectionError, ValueError):
+                return
+            if command is None:
+                return
+            self.wfile.write(self._dispatch(state, command))
+
+    def _read_command(self):
+        line = self.rfile.readline()
+        if not line:
+            return None
+        if not line.startswith(b"*"):
+            raise ValueError("inline commands unsupported")
+        argc = int(line[1:])
+        args = []
+        for _ in range(argc):
+            header = self.rfile.readline()
+            if not header.startswith(b"$"):
+                raise ValueError("expected bulk string")
+            length = int(header[1:])
+            args.append(self.rfile.read(length + 2)[:-2])
+        return args
+
+    def _dispatch(self, state: _State, args) -> bytes:
+        cmd = args[0].upper()
+        with state.lock:
+            if cmd == b"PING":
+                return b"+PONG\r\n"
+            if cmd == b"SET":
+                state.strings[args[1]] = args[2]
+                return b"+OK\r\n"
+            if cmd == b"GET":
+                return _bulk(state.strings.get(args[1]))
+            if cmd == b"DEL":
+                removed = 0
+                for key in args[1:]:
+                    removed += int(state.strings.pop(key, None) is not None)
+                    removed += int(state.hashes.pop(key, None) is not None)
+                return b":%d\r\n" % removed
+            if cmd == b"HSET":
+                bucket = state.hashes.setdefault(args[1], {})
+                added = 0
+                for i in range(2, len(args), 2):
+                    added += int(args[i] not in bucket)
+                    bucket[args[i]] = args[i + 1]
+                return b":%d\r\n" % added
+            if cmd == b"HKEYS":
+                bucket = state.hashes.get(args[1], {})
+                out = b"*%d\r\n" % len(bucket)
+                for field in bucket:
+                    out += _bulk(field)
+                return out
+            if cmd == b"HDEL":
+                bucket = state.hashes.get(args[1], {})
+                removed = 0
+                for field in args[2:]:
+                    removed += int(bucket.pop(field, None) is not None)
+                if not bucket:
+                    state.hashes.pop(args[1], None)
+                return b":%d\r\n" % removed
+            if cmd == b"HLEN":
+                return b":%d\r\n" % len(state.hashes.get(args[1], {}))
+            if cmd == b"FLUSHALL":
+                state.strings.clear()
+                state.hashes.clear()
+                return b"+OK\r\n"
+        return b"-ERR unknown command '%s'\r\n" % cmd
+
+
+class MiniRespServer:
+    def __init__(self) -> None:
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), _Handler, bind_and_activate=True
+        )
+        self._server.daemon_threads = True
+        self._server.state = _State()  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address
+        return f"{host}:{port}"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

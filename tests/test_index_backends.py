@@ -1,0 +1,176 @@
+"""One behavioral contract suite, parameterized over every index backend.
+
+Mirrors the reference's testing idea (pkg/kvcache/kvblock/index_test.go
+``testCommonIndexBehavior`` run against in-memory / cost-aware / redis):
+backends must be interchangeable.
+"""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    EMPTY_BLOCK_HASH,
+    IndexConfig,
+    PodEntry,
+    new_index,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    CostAwareIndexConfig,
+    InMemoryIndexConfig,
+    RedisIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import (
+    InstrumentedIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import RedisIndex
+from tests.helpers.miniresp import MiniRespServer
+
+POD1 = PodEntry("pod-1", "hbm")
+POD1_HOST = PodEntry("pod-1", "host")
+POD2 = PodEntry("pod-2", "hbm")
+
+
+@pytest.fixture(scope="module")
+def resp_server():
+    server = MiniRespServer()
+    yield server
+    server.close()
+
+
+@pytest.fixture(
+    params=["in_memory", "cost_aware", "redis", "instrumented"]
+)
+def index(request, resp_server):
+    if request.param == "in_memory":
+        yield InMemoryIndex(InMemoryIndexConfig(size=10_000))
+    elif request.param == "cost_aware":
+        yield CostAwareMemoryIndex(
+            CostAwareIndexConfig(max_cost_bytes=64 * 1024 * 1024)
+        )
+    elif request.param == "instrumented":
+        yield InstrumentedIndex(InMemoryIndex(InMemoryIndexConfig(size=10_000)))
+    else:
+        idx = RedisIndex(RedisIndexConfig(address=resp_server.address))
+        yield idx
+        idx._client.execute("FLUSHALL")
+
+
+class TestIndexContract:
+    def test_add_then_lookup(self, index):
+        index.add([101, 102], [201, 202], [POD1])
+        found = index.lookup([201, 202])
+        assert set(found) == {201, 202}
+        assert found[201] == [POD1]
+
+    def test_lookup_filters_by_pod_set(self, index):
+        index.add([110], [210], [POD1, POD2])
+        found = index.lookup([210], {"pod-2"})
+        assert found == {210: [POD2]}
+
+    def test_lookup_missing_keys_skipped(self, index):
+        index.add([120], [220], [POD1])
+        found = index.lookup([9999, 220])
+        assert found == {220: [POD1]}
+
+    def test_lookup_empty_keys_raises(self, index):
+        with pytest.raises(ValueError):
+            index.lookup([])
+
+    def test_multiple_tiers_per_pod(self, index):
+        index.add([130], [230], [POD1, POD1_HOST])
+        found = index.lookup([230])
+        assert set(found[230]) == {POD1, POD1_HOST}
+
+    def test_get_request_key_and_eviction(self, index):
+        index.add([140], [240], [POD1, POD2])
+        assert index.get_request_key(140) == 240
+
+        index.evict(140, [POD1])
+        assert index.lookup([240]) == {240: [POD2]}
+
+        index.evict(140, [POD2])
+        # Fully evicted: the key disappears and the engine mapping with it.
+        assert index.lookup([240, 240]) == {}
+        with pytest.raises(KeyError):
+            index.get_request_key(140)
+
+    def test_evict_unknown_engine_key_is_noop(self, index):
+        index.evict(31337, [POD1])
+
+    def test_add_validates_lengths(self, index):
+        with pytest.raises(ValueError):
+            index.add([1, 2], [1], [POD1])
+        with pytest.raises(ValueError):
+            index.add([], [], [POD1])
+        with pytest.raises(ValueError):
+            index.evict(1, [])
+
+    def test_readd_after_evict(self, index):
+        index.add([150], [250], [POD1])
+        index.evict(150, [POD1])
+        index.add([150], [250], [POD2])
+        assert index.lookup([250]) == {250: [POD2]}
+        assert index.get_request_key(150) == 250
+
+
+class TestInMemorySpecifics:
+    def test_pod_cache_bounded(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=100, pod_cache_size=3))
+        pods = [PodEntry(f"pod-{i}", "hbm") for i in range(6)]
+        index.add([1], [2], pods)
+        resident = index.lookup([2])[2]
+        assert len(resident) == 3
+        # Most recently added pods survive.
+        assert set(resident) == set(pods[3:])
+
+    def test_key_lru_eviction(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=2))
+        index.add([1, 2, 3], [11, 12, 13], [POD1])
+        # Capacity 2: the oldest request key fell out.
+        assert index.lookup([11, 12, 13]) == {12: [POD1], 13: [POD1]}
+
+    def test_empty_podcache_stops_scan(self):
+        """A present-but-empty key must cut the lookup early."""
+        index = InMemoryIndex(InMemoryIndexConfig(size=100))
+        index.add([1, 2], [21, 22], [POD1])
+        index.add([3], [23], [POD1])
+        # Manually drain key 22's pods without removing the key.
+        index._data.get(22).entries.remove(POD1)
+        found = index.lookup([21, 22, 23])
+        assert found == {21: [POD1]}
+
+
+class TestCostAwareSpecifics:
+    def test_budget_eviction(self):
+        index = CostAwareMemoryIndex(CostAwareIndexConfig(max_cost_bytes=2000))
+        for i in range(100):
+            index.add([1000 + i], [2000 + i], [POD1])
+        assert index.resident_cost_bytes <= 2000
+        keys = list(range(2000, 2100))
+        found = index.lookup(keys)
+        assert 0 < len(found) < 100
+        # Most recent keys survive.
+        assert 2099 in found
+
+
+def test_factory_backend_priority(resp_server):
+    assert isinstance(new_index(IndexConfig()), InMemoryIndex)
+    assert isinstance(
+        new_index(IndexConfig(cost_aware_config=CostAwareIndexConfig())),
+        CostAwareMemoryIndex,
+    )
+    assert isinstance(
+        new_index(
+            IndexConfig(
+                in_memory_config=None,
+                redis_config=RedisIndexConfig(address=resp_server.address),
+            )
+        ),
+        RedisIndex,
+    )
+    wrapped = new_index(IndexConfig(enable_metrics=True))
+    assert isinstance(wrapped, InstrumentedIndex)
+    assert isinstance(wrapped.inner, InMemoryIndex)

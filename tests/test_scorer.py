@@ -1,0 +1,74 @@
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
+    ScorerConfig,
+    new_scorer,
+)
+
+
+def entry(pod, tier="hbm"):
+    return PodEntry(pod, tier)
+
+
+def make_scorer():
+    return new_scorer(ScorerConfig())
+
+
+class TestLongestPrefixScorer:
+    def test_empty_keys(self):
+        assert make_scorer().score([], {}) == {}
+
+    def test_single_pod_full_prefix(self):
+        scorer = make_scorer()
+        keys = [1, 2, 3]
+        mapping = {k: [entry("a")] for k in keys}
+        assert scorer.score(keys, mapping) == {"a": 3.0}
+
+    def test_prefix_break_stops_scoring(self):
+        scorer = make_scorer()
+        keys = [1, 2, 3]
+        mapping = {1: [entry("a")], 3: [entry("a")]}  # gap at key 2
+        assert scorer.score(keys, mapping) == {"a": 1.0}
+
+    def test_pod_missing_from_first_key_scores_zero(self):
+        scorer = make_scorer()
+        keys = [1, 2]
+        mapping = {1: [entry("a")], 2: [entry("a"), entry("b")]}
+        scores = scorer.score(keys, mapping)
+        assert scores == {"a": 2.0}
+        assert "b" not in scores
+
+    def test_intersection_shrinks_active_set(self):
+        scorer = make_scorer()
+        keys = [1, 2, 3]
+        mapping = {
+            1: [entry("a"), entry("b")],
+            2: [entry("a"), entry("b")],
+            3: [entry("a")],
+        }
+        assert scorer.score(keys, mapping) == {"a": 3.0, "b": 2.0}
+
+    def test_tier_weights(self):
+        scorer = make_scorer()
+        keys = [1, 2]
+        mapping = {
+            1: [entry("a", "host"), entry("b", "hbm")],
+            2: [entry("a", "host"), entry("b", "shared_storage")],
+        }
+        scores = scorer.score(keys, mapping)
+        assert scores["a"] == 1.6  # 0.8 + 0.8
+        assert scores["b"] == 1.5  # 1.0 + 0.5
+
+    def test_max_weight_across_tiers_same_pod(self):
+        scorer = make_scorer()
+        mapping = {1: [entry("a", "host"), entry("a", "hbm")]}
+        assert scorer.score([1], mapping) == {"a": 1.0}
+
+    def test_unknown_tier_defaults_to_one(self):
+        scorer = make_scorer()
+        mapping = {1: [entry("a", "mystery-tier")]}
+        assert scorer.score([1], mapping) == {"a": 1.0}
+
+    def test_gpu_aliases_supported(self):
+        scorer = make_scorer()
+        mapping = {1: [entry("a", "gpu"), entry("b", "cpu")]}
+        assert scorer.score([1], mapping) == {"a": 1.0, "b": 0.8}

@@ -1,0 +1,157 @@
+"""Hash-chain parity tests.
+
+The chain must agree bit-for-bit with the reference indexer
+(pkg/kvcache/kvblock/token_processor.go) — golden vectors here are
+hand-computed from the published algorithm (FNV-64a over RFC 8949 canonical
+CBOR) with independent encodings written out byte by byte, so a bug in the
+production encoder cannot hide in the test.
+"""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cbor_canonical import (
+    encode_canonical,
+    encode_hash_payload,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+    engine_hash_to_uint64,
+    fnv1a_64,
+)
+
+# Published FNV-1a 64-bit test vectors.
+FNV_VECTORS = {
+    b"": 0xCBF29CE484222325,
+    b"a": 0xAF63DC4C8601EC8C,
+    b"foobar": 0x85944171F73967E8,
+}
+
+
+def test_fnv1a_64_known_vectors():
+    for data, expected in FNV_VECTORS.items():
+        assert fnv1a_64(data) == expected
+
+
+class TestCanonicalCbor:
+    def test_uint_boundaries(self):
+        # Shortest-form heads at every width boundary (RFC 8949 §4.2.1).
+        assert encode_canonical(0) == bytes([0x00])
+        assert encode_canonical(23) == bytes([0x17])
+        assert encode_canonical(24) == bytes([0x18, 24])
+        assert encode_canonical(255) == bytes([0x18, 0xFF])
+        assert encode_canonical(256) == bytes([0x19, 0x01, 0x00])
+        assert encode_canonical(65536) == bytes([0x1A, 0x00, 0x01, 0x00, 0x00])
+        assert encode_canonical(2**32) == bytes(
+            [0x1B, 0, 0, 0, 1, 0, 0, 0, 0]
+        )
+        assert encode_canonical(2**64 - 1) == bytes([0x1B] + [0xFF] * 8)
+
+    def test_text_null_array(self):
+        assert encode_canonical(None) == bytes([0xF6])
+        assert encode_canonical("m") == bytes([0x61, 0x6D])
+        assert encode_canonical([1, 2]) == bytes([0x82, 0x01, 0x02])
+
+    def test_hash_payload_layout(self):
+        # [parent=5, tokens=[1, 300], extra=None], hand-encoded.
+        expected = bytes(
+            [0x83, 0x05, 0x82, 0x01, 0x19, 0x01, 0x2C, 0xF6]
+        )
+        assert encode_hash_payload(5, [1, 300], None) == expected
+
+    def test_hash_payload_nil_tokens_and_model(self):
+        # [parent=0xCBF29CE484222325, tokens=null, extra="m"]
+        expected = (
+            bytes([0x83, 0x1B])
+            + (0xCBF29CE484222325).to_bytes(8, "big")
+            + bytes([0xF6, 0x61, 0x6D])
+        )
+        assert encode_hash_payload(0xCBF29CE484222325, None, "m") == expected
+
+
+class TestChunkedTokenDatabase:
+    def test_golden_chain_empty_seed(self):
+        """Fully hand-derived two-block chain for seed=""."""
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=2))
+        init = fnv1a_64(b"")  # seed "" -> FNV offset basis
+        model_init = fnv1a_64(encode_hash_payload(init, None, "m"))
+        h1 = fnv1a_64(encode_hash_payload(model_init, [1, 2], None))
+        h2 = fnv1a_64(encode_hash_payload(h1, [3, 4], None))
+        assert db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, [1, 2, 3, 4], "m"
+        ) == [h1, h2]
+
+    def test_deterministic_across_instances(self):
+        cfg = TokenProcessorConfig(block_size=4, hash_seed="42")
+        tokens = list(range(20))
+        a = ChunkedTokenDatabase(cfg).tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, tokens, "model-x"
+        )
+        b = ChunkedTokenDatabase(cfg).tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, tokens, "model-x"
+        )
+        assert a == b
+        assert len(a) == 5
+
+    def test_no_partial_blocks(self):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=16))
+        assert db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, [1] * 15, "m") == []
+        assert (
+            len(db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, [1] * 47, "m"))
+            == 2
+        )
+
+    def test_seed_and_model_change_hashes(self):
+        tokens = list(range(16))
+        base = ChunkedTokenDatabase(
+            TokenProcessorConfig(hash_seed="")
+        ).tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, "m1")
+        seeded = ChunkedTokenDatabase(
+            TokenProcessorConfig(hash_seed="7")
+        ).tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, "m1")
+        other_model = ChunkedTokenDatabase(
+            TokenProcessorConfig(hash_seed="")
+        ).tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, "m2")
+        assert base != seeded
+        assert base != other_model
+
+    def test_parent_chain_continuation(self):
+        """Keys for [A|B] computed at once equal keys for A then B chained
+        off A's last key — the event write path depends on this."""
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        tokens = list(range(16))
+        whole = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, "m")
+        head = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens[:8], "m")
+        tail = db.tokens_to_kv_block_keys(head[-1], tokens[8:], "m")
+        assert head + tail == whole
+
+    def test_block_size_boundary_exact(self):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        keys = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, list(range(8)), "m")
+        assert len(keys) == 2
+        assert len(set(keys)) == 2
+
+
+class TestEngineHashNormalization:
+    def test_int_passthrough(self):
+        assert engine_hash_to_uint64(42) == 42
+        # Negative int64 wire values map to their uint64 bit pattern.
+        assert engine_hash_to_uint64(-1) == 0xFFFFFFFFFFFFFFFF
+
+    def test_bytes_last8_big_endian(self):
+        digest = bytes(range(32))  # e.g. a sha256_cbor digest
+        assert engine_hash_to_uint64(digest) == int.from_bytes(
+            digest[-8:], "big"
+        )
+
+    def test_short_bytes_zero_padded(self):
+        assert engine_hash_to_uint64(b"\x01\x02") == 0x0102
+
+    def test_invalid_inputs(self):
+        with pytest.raises(ValueError):
+            engine_hash_to_uint64(b"")
+        with pytest.raises(TypeError):
+            engine_hash_to_uint64("nope")
+        with pytest.raises(TypeError):
+            engine_hash_to_uint64(True)
