@@ -23,7 +23,20 @@ from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set
 
-EMPTY_BLOCK_HASH = 0
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+)
+
+__all__ = [
+    "EMPTY_BLOCK_HASH",
+    "PodEntry",
+    "Index",
+    "IndexConfig",
+    "InMemoryIndexConfig",
+    "CostAwareIndexConfig",
+    "RedisIndexConfig",
+    "new_index",
+]
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,16 @@
+from llm_d_kv_cache_manager_tpu.kvevents.events import (  # noqa: F401
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+    decode_event,
+    decode_event_batch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (  # noqa: F401
+    Message,
+    Pool,
+    PoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (  # noqa: F401
+    SubscriberManager,
+)

@@ -1,0 +1,249 @@
+"""Sharded, per-pod-ordered event ingestion pool (the index write path).
+
+Messages are sharded onto worker threads by ``FNV-1a-32(pod_id) % N`` so
+events from one pod are always processed in publish order while the fleet
+fans out across workers (reference: pkg/kvevents/pool.go:161-173).
+
+Digest semantics (reference pool.go:233-334):
+
+* ``BlockStored``: engine keys come from the event's hashes (normalized to
+  uint64); request keys are *recomputed* from the event's token IDs with
+  the indexer's own hash chain, chaining off the parent block's request key
+  via ``index.get_request_key`` — the dual-key design that makes routing
+  independent of per-engine hash configuration.  LoRA name, when present,
+  replaces the model name in the hash chain.  Tier comes from ``medium``
+  (lowercased), default "hbm" for TPU fleets.
+* ``BlockRemoved``: evict each engine key.
+* ``AllBlocksCleared``: intentionally a no-op, matching the reference
+  (pool.go:328-329) — engines emit granular removals too.
+
+Poison pills (undecodable payloads) are dropped, never retried.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+    TokenProcessor,
+    engine_hash_to_uint64,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    EventDecodeError,
+    decode_event,
+    decode_event_batch,
+)
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
+
+logger = get_logger("kvevents.pool")
+
+# TPU pods' on-chip tier; events without an explicit medium default here
+# (GPU-era fleets default to "gpu" — both score 1.0 by default).
+DEFAULT_EVENT_SOURCE_DEVICE_TIER = "hbm"
+
+_FNV32_OFFSET = 0x811C9DC5
+_FNV32_PRIME = 0x01000193
+
+
+def fnv1a_32(data: bytes) -> int:
+    h = _FNV32_OFFSET
+    for byte in data:
+        h = ((h ^ byte) * _FNV32_PRIME) & 0xFFFFFFFF
+    return h
+
+
+@dataclass
+class Message:
+    """One raw event-stream message as received from a pod."""
+
+    topic: str
+    payload: bytes
+    pod_identifier: str
+    model_name: str
+    seq: int = 0
+
+
+@dataclass
+class PoolConfig:
+    concurrency: int = 4
+    default_device_tier: str = DEFAULT_EVENT_SOURCE_DEVICE_TIER
+
+
+class Pool:
+    """N worker threads, each draining its own FIFO queue."""
+
+    def __init__(
+        self,
+        index: Index,
+        token_processor: TokenProcessor,
+        config: Optional[PoolConfig] = None,
+    ) -> None:
+        self.config = config or PoolConfig()
+        if self.config.concurrency <= 0:
+            raise ValueError("pool concurrency must be positive")
+        self._index = index
+        self._token_processor = token_processor
+        self._queues: List["queue.Queue[Optional[Message]]"] = [
+            queue.Queue() for _ in range(self.config.concurrency)
+        ]
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            for i in range(self.config.concurrency):
+                thread = threading.Thread(
+                    target=self._worker,
+                    args=(i,),
+                    name=f"kvtpu-events-{i}",
+                    daemon=True,
+                )
+                thread.start()
+                self._threads.append(thread)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if not self._started:
+                return
+            for q in self._queues:
+                q.put(None)
+            for thread in self._threads:
+                thread.join(timeout=10)
+            self._threads.clear()
+            self._started = False
+
+    def drain(self) -> None:
+        """Block until every queued message has been processed (tests)."""
+        for q in self._queues:
+            q.join()
+
+    def add_task(self, message: Message) -> None:
+        shard = fnv1a_32(message.pod_identifier.encode()) % len(self._queues)
+        self._queues[shard].put(message)
+
+    def _worker(self, worker_index: int) -> None:
+        q = self._queues[worker_index]
+        while True:
+            message = q.get()
+            try:
+                if message is None:
+                    return
+                self._process_message(message)
+            except Exception:
+                logger.exception(
+                    "event worker %d failed processing a message; dropping",
+                    worker_index,
+                )
+            finally:
+                q.task_done()
+
+    def _process_message(self, message: Message) -> None:
+        try:
+            batch = decode_event_batch(message.payload)
+        except EventDecodeError as exc:
+            logger.debug("dropping poison-pill message: %s", exc)
+            return
+
+        for raw_event in batch.events:
+            try:
+                event = decode_event(raw_event)
+            except (EventDecodeError, TypeError, ValueError) as exc:
+                # Per-event skip: one malformed event must not drop the
+                # rest of the batch.
+                logger.debug("skipping undecodable event: %s", exc)
+                continue
+            self._digest(message, event)
+
+    def _digest(self, message: Message, event) -> None:
+        if isinstance(event, BlockStored):
+            self._digest_block_stored(message, event)
+        elif isinstance(event, BlockRemoved):
+            self._digest_block_removed(message, event)
+        elif isinstance(event, AllBlocksCleared):
+            # Intentional no-op; granular BlockRemoved events follow.
+            return
+
+    def _tier(self, medium: Optional[str]) -> str:
+        if medium:
+            return medium.lower()
+        return self.config.default_device_tier
+
+    def _digest_block_stored(
+        self, message: Message, event: BlockStored
+    ) -> None:
+        entries = [PodEntry(message.pod_identifier, self._tier(event.medium))]
+
+        # LoRA adapters have their own KV-incompatible hash space.
+        effective_model = event.lora_name or message.model_name
+
+        engine_keys = []
+        for raw_hash in event.block_hashes:
+            try:
+                engine_keys.append(engine_hash_to_uint64(raw_hash))
+            except (TypeError, ValueError) as exc:
+                logger.debug("skipping bad block hash %r: %s", raw_hash, exc)
+        if not engine_keys:
+            return
+
+        parent_request_key = EMPTY_BLOCK_HASH
+        if event.parent_block_hash is not None:
+            try:
+                parent_engine_key = engine_hash_to_uint64(
+                    event.parent_block_hash
+                )
+                parent_request_key = self._index.get_request_key(
+                    parent_engine_key
+                )
+            except (TypeError, ValueError, KeyError) as exc:
+                # Parent unknown (evicted or never seen): skip the event
+                # rather than index keys hashed off the wrong root.
+                trace(
+                    logger,
+                    "parent block unresolvable for pod %s: %s",
+                    message.pod_identifier,
+                    exc,
+                )
+                return
+
+        request_keys = self._token_processor.tokens_to_kv_block_keys(
+            parent_request_key, event.token_ids, effective_model
+        )
+        if len(request_keys) != len(engine_keys):
+            logger.debug(
+                "engine reported %d hashes but token ids produced %d request "
+                "keys (pod %s); indexing the overlapping prefix",
+                len(engine_keys),
+                len(request_keys),
+                message.pod_identifier,
+            )
+            overlap = min(len(request_keys), len(engine_keys))
+            if overlap == 0:
+                return
+            engine_keys = engine_keys[:overlap]
+            request_keys = request_keys[:overlap]
+
+        self._index.add(engine_keys, request_keys, entries)
+
+    def _digest_block_removed(
+        self, message: Message, event: BlockRemoved
+    ) -> None:
+        entries = [PodEntry(message.pod_identifier, self._tier(event.medium))]
+        for raw_hash in event.block_hashes:
+            try:
+                engine_key = engine_hash_to_uint64(raw_hash)
+            except (TypeError, ValueError) as exc:
+                logger.debug("skipping bad removal hash %r: %s", raw_hash, exc)
+                continue
+            self._index.evict(engine_key, entries)

@@ -1,0 +1,59 @@
+"""ZMQ PUB helper publishing KVEvent batches the way engine pods do.
+
+Used by demos and tests to simulate a fleet (reference pattern:
+examples/helper/publisher.go:57-84).  Message = 3 parts:
+``[topic, seq (u64 BE), msgpack(EventBatch)]``, topic
+``kv@<pod-id>@<model>``.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Optional
+
+import zmq
+
+from llm_d_kv_cache_manager_tpu.kvevents.events import EventBatch
+from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import TOPIC_PREFIX
+
+
+class Publisher:
+    def __init__(
+        self,
+        endpoint: str,
+        pod_identifier: str,
+        model_name: str,
+        bind: bool = True,
+        context: Optional[zmq.Context] = None,
+    ) -> None:
+        self.pod_identifier = pod_identifier
+        self.model_name = model_name
+        self._context = context or zmq.Context.instance()
+        self._socket = self._context.socket(zmq.PUB)
+        self._socket.setsockopt(zmq.LINGER, 0)
+        if bind:
+            self._socket.bind(endpoint)
+        else:
+            self._socket.connect(endpoint)
+        self._seq = 0
+
+    @property
+    def topic(self) -> str:
+        return f"{TOPIC_PREFIX}{self.pod_identifier}@{self.model_name}"
+
+    def publish(self, *events) -> int:
+        """Publish events as one batch; returns the sequence number used."""
+        batch = EventBatch(ts=time.time(), events=list(events))
+        self._seq += 1
+        self._socket.send_multipart(
+            [
+                self.topic.encode(),
+                struct.pack(">Q", self._seq),
+                batch.encode(),
+            ]
+        )
+        return self._seq
+
+    def close(self) -> None:
+        self._socket.close()

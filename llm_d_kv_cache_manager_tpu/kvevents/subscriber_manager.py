@@ -1,0 +1,98 @@
+"""Tracks one ZMQ subscriber per live engine pod.
+
+Idempotent ``ensure_subscriber``; an endpoint change (pod rescheduled with a
+new IP) restarts the subscriber; ``remove_subscriber`` on pod death; full
+``shutdown``.  Driven by pod-discovery (the k8s reconciler adapter) or
+manually in tests/demos.  (Capability parity:
+pkg/kvevents/subscriber_manager.go.)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+import zmq
+
+from llm_d_kv_cache_manager_tpu.kvevents.pool import Message
+from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+    ZMQSubscriber,
+    ZMQSubscriberConfig,
+)
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("kvevents.subscriber_manager")
+
+
+class SubscriberManager:
+    def __init__(
+        self,
+        sink: Callable[[Message], None],
+        context: Optional[zmq.Context] = None,
+        bind: bool = False,
+    ) -> None:
+        self._sink = sink
+        self._context = context
+        self._bind = bind
+        self._lock = threading.Lock()
+        self._subscribers: Dict[str, ZMQSubscriber] = {}
+
+    def ensure_subscriber(self, pod_identifier: str, endpoint: str) -> bool:
+        """Start (or restart on endpoint change) a subscriber for the pod.
+
+        Returns True if a new subscriber was started.
+        """
+        stale: Optional[ZMQSubscriber] = None
+        with self._lock:
+            existing = self._subscribers.get(pod_identifier)
+            if existing is not None:
+                if existing.config.endpoint == endpoint:
+                    return False
+                logger.info(
+                    "endpoint change for pod %s: %s -> %s; restarting",
+                    pod_identifier,
+                    existing.config.endpoint,
+                    endpoint,
+                )
+                stale = existing
+                del self._subscribers[pod_identifier]
+
+            subscriber = ZMQSubscriber(
+                ZMQSubscriberConfig(
+                    endpoint=endpoint,
+                    pod_identifier=pod_identifier,
+                    bind=self._bind,
+                ),
+                self._sink,
+                context=self._context,
+            )
+            subscriber.start()
+            self._subscribers[pod_identifier] = subscriber
+            logger.info(
+                "subscribed to pod %s at %s", pod_identifier, endpoint
+            )
+        # Join the stale subscriber's thread outside the lock: a wedged
+        # close must not stall fleet-wide reconciliation.
+        if stale is not None:
+            stale.stop()
+        return True
+
+    def remove_subscriber(self, pod_identifier: str) -> bool:
+        with self._lock:
+            subscriber = self._subscribers.pop(pod_identifier, None)
+        if subscriber is None:
+            return False
+        subscriber.stop()
+        logger.info("unsubscribed from pod %s", pod_identifier)
+        return True
+
+    def active_pods(self) -> list:
+        with self._lock:
+            return sorted(self._subscribers)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            subscribers = list(self._subscribers.values())
+            self._subscribers.clear()
+        for subscriber in subscribers:
+            subscriber.stop()

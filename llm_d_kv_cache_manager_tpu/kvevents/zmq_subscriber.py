@@ -1,0 +1,187 @@
+"""ZMQ SUB subscriber for one engine pod's KV-event stream.
+
+Wire format (reference: pkg/kvevents/zmq_subscriber.go:135-155, matching
+vLLM's event publisher): 3-part messages ``[topic, seq, payload]`` where
+``topic = "kv@<pod-id>@<model>"``, ``seq`` is a big-endian uint64, and
+``payload`` is a msgpack ``EventBatch``.
+
+Lifecycle: a dedicated thread polls with a short timeout so cancellation is
+responsive; socket errors tear the socket down and reconnect after a
+backoff.  Subscribers tolerate absent publishers (ZMQ connects lazily), so
+the fleet can be simulated — or slow to start — without errors.
+
+Sequence numbers are parsed and surfaced for gap detection.  The reference
+leaves them unused (zmq_subscriber.go:143, a noted improvement
+opportunity); here a gap increments a counter and logs, giving operators a
+signal that events were lost and scores may be stale until re-store.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import zmq
+
+from llm_d_kv_cache_manager_tpu.kvevents.pool import Message
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
+
+logger = get_logger("kvevents.zmq")
+
+TOPIC_PREFIX = "kv@"
+POLL_INTERVAL_MS = 250
+RECONNECT_BACKOFF_SECONDS = 5.0
+
+
+def parse_topic(topic: str) -> Optional[tuple]:
+    """``kv@<pod-id>@<model>`` -> (pod_id, model); None if malformed.
+
+    Model names may themselves contain ``@`` (LoRA refs); split only twice.
+    """
+    if not topic.startswith(TOPIC_PREFIX):
+        return None
+    rest = topic[len(TOPIC_PREFIX):]
+    pod_id, sep, model = rest.partition("@")
+    if not sep or not pod_id or not model:
+        return None
+    return pod_id, model
+
+
+@dataclass
+class ZMQSubscriberConfig:
+    endpoint: str
+    pod_identifier: str
+    # Subscribe to this pod's topics only; "" subscribes to everything.
+    topic_filter: Optional[str] = None
+    # bind=True for local test endpoints, connect for remote pods
+    # (reference: zmq_subscriber.go:92-105).
+    bind: bool = False
+
+
+class ZMQSubscriber:
+    """One SUB socket + polling thread feeding a message sink."""
+
+    def __init__(
+        self,
+        config: ZMQSubscriberConfig,
+        sink: Callable[[Message], None],
+        context: Optional[zmq.Context] = None,
+    ) -> None:
+        self.config = config
+        self._sink = sink
+        self._context = context or zmq.Context.instance()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Sequence numbers are independent per topic (model/LoRA streams
+        # from one pod each number from their own counter).
+        self._last_seq_by_topic: dict = {}
+        self.gap_count = 0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"kvtpu-zmq-{self.config.pod_identifier}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _topic_filter(self) -> bytes:
+        if self.config.topic_filter is not None:
+            return self.config.topic_filter.encode()
+        return f"{TOPIC_PREFIX}{self.config.pod_identifier}@".encode()
+
+    def _open_socket(self) -> zmq.Socket:
+        sock = self._context.socket(zmq.SUB)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.setsockopt(zmq.SUBSCRIBE, self._topic_filter())
+        if self.config.bind:
+            sock.bind(self.config.endpoint)
+        else:
+            sock.connect(self.config.endpoint)
+        return sock
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            sock = None
+            try:
+                sock = self._open_socket()
+                self._poll_loop(sock)
+            except Exception as exc:  # noqa: BLE001 — thread must survive
+                logger.warning(
+                    "subscriber for %s errored (%s); reconnecting in %.0fs",
+                    self.config.pod_identifier,
+                    exc,
+                    RECONNECT_BACKOFF_SECONDS,
+                )
+                self._stop.wait(RECONNECT_BACKOFF_SECONDS)
+            finally:
+                if sock is not None:
+                    sock.close()
+
+    def _poll_loop(self, sock: zmq.Socket) -> None:
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+        while not self._stop.is_set():
+            if not dict(poller.poll(POLL_INTERVAL_MS)):
+                continue
+            parts = sock.recv_multipart()
+            message = self._parse_message(parts)
+            if message is None:
+                continue
+            try:
+                self._sink(message)
+            except Exception:  # noqa: BLE001 — sink bugs must not kill us
+                logger.exception(
+                    "sink failed for a message from %s; dropping it",
+                    self.config.pod_identifier,
+                )
+
+    def _parse_message(self, parts) -> Optional[Message]:
+        if len(parts) != 3:
+            logger.debug("dropping %d-part message", len(parts))
+            return None
+        topic_raw, seq_raw, payload = parts
+        try:
+            topic = topic_raw.decode()
+        except UnicodeDecodeError:
+            logger.debug("dropping message with undecodable topic")
+            return None
+        parsed = parse_topic(topic)
+        if parsed is None:
+            logger.debug("dropping message with malformed topic %r", topic)
+            return None
+        pod_id, model = parsed
+
+        seq = 0
+        if len(seq_raw) == 8:
+            seq = struct.unpack(">Q", seq_raw)[0]
+            last_seq = self._last_seq_by_topic.get(topic)
+            if last_seq is not None and seq > last_seq + 1:
+                self.gap_count += seq - last_seq - 1
+                logger.warning(
+                    "sequence gap on %s: %d -> %d (%d events lost)",
+                    topic,
+                    last_seq,
+                    seq,
+                    seq - last_seq - 1,
+                )
+            self._last_seq_by_topic[topic] = seq
+
+        trace(logger, "message topic=%s seq=%d", topic, seq)
+        return Message(
+            topic=topic,
+            payload=payload,
+            pod_identifier=pod_id,
+            model_name=model,
+            seq=seq,
+        )

@@ -101,14 +101,18 @@ def start_metrics_logging(interval_seconds: float = 60.0) -> threading.Event:
     """Log a periodic one-line metrics beat; returns a stop event."""
     stop = threading.Event()
 
+    def counter_value(counter: Counter) -> float:
+        # Public API: first sample of a Counter is its _total value.
+        return counter.collect()[0].samples[0].value
+
     def beat() -> None:
         while not stop.wait(interval_seconds):
             logger.info(
                 "metrics beat: admissions=%d evictions=%d lookups=%d hits=%d",
-                METRICS.index_admissions._value.get(),
-                METRICS.index_evictions._value.get(),
-                METRICS.index_lookup_requests._value.get(),
-                METRICS.index_lookup_hits._value.get(),
+                counter_value(METRICS.index_admissions),
+                counter_value(METRICS.index_evictions),
+                counter_value(METRICS.index_lookup_requests),
+                counter_value(METRICS.index_lookup_hits),
             )
 
     thread = threading.Thread(target=beat, name="kvtpu-metrics-beat", daemon=True)

@@ -1,0 +1,291 @@
+"""KVEvents codec + ingestion pool tests (fleet simulated by synthetic
+events, per the reference's test strategy)."""
+
+import msgpack
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    EMPTY_BLOCK_HASH,
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+    PodEntry,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import InMemoryIndexConfig
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+    EventDecodeError,
+    decode_event,
+    decode_event_batch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    Message,
+    Pool,
+    PoolConfig,
+    fnv1a_32,
+)
+
+MODEL = "m"
+POD = "pod-1"
+
+
+def make_pool(concurrency=2, block_size=4):
+    index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+    db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=block_size))
+    pool = Pool(index, db, PoolConfig(concurrency=concurrency))
+    pool.start()
+    return pool, index, db
+
+
+def deliver(pool, *events, pod=POD, model=MODEL):
+    batch = EventBatch(ts=1.0, events=list(events))
+    pool.add_task(
+        Message(
+            topic=f"kv@{pod}@{model}",
+            payload=batch.encode(),
+            pod_identifier=pod,
+            model_name=model,
+        )
+    )
+    pool.drain()
+
+
+class TestCodec:
+    def test_batch_roundtrip(self):
+        stored = BlockStored(
+            block_hashes=[1, 2],
+            parent_block_hash=None,
+            token_ids=[5, 6, 7, 8],
+            block_size=4,
+            medium="hbm",
+        )
+        batch = EventBatch(ts=123.5, events=[stored], data_parallel_rank=3)
+        decoded = decode_event_batch(batch.encode())
+        assert decoded.ts == 123.5
+        assert decoded.data_parallel_rank == 3
+        event = decode_event(decoded.events[0])
+        assert isinstance(event, BlockStored)
+        assert event.block_hashes == [1, 2]
+        assert event.token_ids == [5, 6, 7, 8]
+        assert event.medium == "hbm"
+        assert event.lora_name is None
+
+    def test_legacy_event_without_optional_fields(self):
+        # Old publishers omit lora_id/medium/lora_name entirely.
+        raw = ["BlockStored", [9], None, [1, 2, 3, 4], 4]
+        event = decode_event(raw)
+        assert event.medium is None and event.lora_id is None
+
+    def test_batch_without_dp_rank(self):
+        payload = msgpack.packb([1.0, []])
+        batch = decode_event_batch(payload)
+        assert batch.data_parallel_rank is None
+
+    def test_bytes_hashes_preserved(self):
+        digest = bytes(range(32))
+        raw = ["BlockStored", [digest], digest, [1], 1]
+        event = decode_event(raw)
+        assert event.block_hashes == [digest]
+
+    def test_block_removed_roundtrip(self):
+        decoded = decode_event_batch(
+            EventBatch(ts=0.0, events=[BlockRemoved([7], medium="host")]).encode()
+        )
+        event = decode_event(decoded.events[0])
+        assert isinstance(event, BlockRemoved)
+        assert event.medium == "host"
+
+    def test_all_blocks_cleared(self):
+        assert isinstance(decode_event(["AllBlocksCleared"]), AllBlocksCleared)
+
+    def test_malformed_inputs(self):
+        with pytest.raises(EventDecodeError):
+            decode_event_batch(b"\xc1garbage")
+        with pytest.raises(EventDecodeError):
+            decode_event_batch(msgpack.packb("not a batch"))
+        with pytest.raises(EventDecodeError):
+            decode_event(["UnknownTag", 1])
+        with pytest.raises(EventDecodeError):
+            decode_event(["BlockStored", [1]])  # too few fields
+
+
+class TestPoolDigest:
+    def test_block_stored_indexes_request_keys(self):
+        pool, index, db = make_pool()
+        tokens = [1, 2, 3, 4, 5, 6, 7, 8]
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[0xA, 0xB],
+                parent_block_hash=None,
+                token_ids=tokens,
+                block_size=4,
+            ),
+        )
+        request_keys = db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, tokens, MODEL
+        )
+        found = index.lookup(request_keys)
+        assert set(found) == set(request_keys)
+        assert found[request_keys[0]] == [PodEntry(POD, "hbm")]
+        assert index.get_request_key(0xA) == request_keys[0]
+        pool.shutdown()
+
+    def test_parent_chaining_across_events(self):
+        pool, index, db = make_pool()
+        tokens = list(range(16))
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[0x1, 0x2],
+                parent_block_hash=None,
+                token_ids=tokens[:8],
+                block_size=4,
+            ),
+        )
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[0x3, 0x4],
+                parent_block_hash=0x2,
+                token_ids=tokens[8:],
+                block_size=4,
+            ),
+        )
+        expected = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, MODEL)
+        found = index.lookup(expected)
+        assert set(found) == set(expected), "chained event must extend prefix"
+        pool.shutdown()
+
+    def test_unknown_parent_drops_event(self):
+        pool, index, db = make_pool()
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[0x9],
+                parent_block_hash=0xDEAD,
+                token_ids=[1, 2, 3, 4],
+                block_size=4,
+            ),
+        )
+        with pytest.raises(KeyError):
+            index.get_request_key(0x9)
+        pool.shutdown()
+
+    def test_medium_and_lora(self):
+        pool, index, db = make_pool()
+        tokens = [1, 2, 3, 4]
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[0x1],
+                parent_block_hash=None,
+                token_ids=tokens,
+                block_size=4,
+                medium="HOST",
+                lora_name="my-lora",
+            ),
+        )
+        lora_keys = db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, tokens, "my-lora"
+        )
+        base_keys = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, MODEL)
+        assert index.lookup(lora_keys)[lora_keys[0]] == [
+            PodEntry(POD, "host")
+        ]
+        assert not index.lookup(base_keys)
+        pool.shutdown()
+
+    def test_block_removed_evicts(self):
+        pool, index, db = make_pool()
+        tokens = [1, 2, 3, 4]
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[0x1],
+                parent_block_hash=None,
+                token_ids=tokens,
+                block_size=4,
+            ),
+        )
+        deliver(pool, BlockRemoved(block_hashes=[0x1]))
+        keys = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, MODEL)
+        assert not index.lookup(keys)
+        pool.shutdown()
+
+    def test_sha256_byte_hashes_and_parent(self):
+        pool, index, db = make_pool()
+        digest_a = bytes([0xAA]) * 32
+        digest_b = bytes([0xBB]) * 32
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[digest_a],
+                parent_block_hash=None,
+                token_ids=[1, 2, 3, 4],
+                block_size=4,
+            ),
+        )
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[digest_b],
+                parent_block_hash=digest_a,
+                token_ids=[5, 6, 7, 8],
+                block_size=4,
+            ),
+        )
+        expected = db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, list(range(1, 9)), MODEL
+        )
+        assert set(index.lookup(expected)) == set(expected)
+        pool.shutdown()
+
+    def test_poison_pill_dropped(self):
+        pool, index, _ = make_pool()
+        pool.add_task(
+            Message(
+                topic="kv@pod-1@m",
+                payload=b"\xc1 not msgpack",
+                pod_identifier=POD,
+                model_name=MODEL,
+            )
+        )
+        pool.drain()  # must not wedge the worker
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[0x5],
+                parent_block_hash=None,
+                token_ids=[1, 2, 3, 4],
+                block_size=4,
+            ),
+        )
+        assert index.get_request_key(0x5)
+        pool.shutdown()
+
+    def test_all_blocks_cleared_noop(self):
+        pool, index, db = make_pool()
+        deliver(
+            pool,
+            BlockStored(
+                block_hashes=[0x1],
+                parent_block_hash=None,
+                token_ids=[1, 2, 3, 4],
+                block_size=4,
+            ),
+        )
+        deliver(pool, AllBlocksCleared())
+        keys = db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, [1, 2, 3, 4], MODEL
+        )
+        assert index.lookup(keys), "AllBlocksCleared must not clear the index"
+        pool.shutdown()
+
+
+def test_shard_selection_is_stable():
+    assert fnv1a_32(b"pod-1") == fnv1a_32(b"pod-1")
+    assert fnv1a_32(b"pod-1") != fnv1a_32(b"pod-2")

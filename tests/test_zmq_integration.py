@@ -1,0 +1,93 @@
+"""ZMQ transport integration: publisher -> subscriber -> pool -> index.
+
+Follows the reference's integration strategy
+(tests/integration/kv_events_test.go): subscriber lifecycle against
+absent endpoints needs no publisher at all; the end-to-end flow runs over
+loopback TCP in-process.
+"""
+
+import time
+
+import pytest
+import zmq
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    EMPTY_BLOCK_HASH,
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import InMemoryIndexConfig
+from llm_d_kv_cache_manager_tpu.kvevents.events import BlockStored
+from llm_d_kv_cache_manager_tpu.kvevents.pool import Pool, PoolConfig
+from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher
+from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
+    SubscriberManager,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import parse_topic
+
+MODEL = "test-model"
+
+
+def test_parse_topic():
+    assert parse_topic("kv@pod-1@org/model") == ("pod-1", "org/model")
+    assert parse_topic("kv@pod@m@lora") == ("pod", "m@lora")
+    assert parse_topic("other@pod@m") is None
+    assert parse_topic("kv@podonly") is None
+    assert parse_topic("kv@@model") is None
+
+
+class TestSubscriberManagerLifecycle:
+    def test_lifecycle_without_publishers(self):
+        manager = SubscriberManager(sink=lambda m: None)
+        # Unroutable endpoints are fine: ZMQ connects lazily and retries.
+        assert manager.ensure_subscriber("pod-a", "tcp://10.255.0.1:5557")
+        assert not manager.ensure_subscriber("pod-a", "tcp://10.255.0.1:5557")
+        # Endpoint change restarts.
+        assert manager.ensure_subscriber("pod-a", "tcp://10.255.0.2:5557")
+        assert manager.ensure_subscriber("pod-b", "tcp://10.255.0.3:5557")
+        assert manager.active_pods() == ["pod-a", "pod-b"]
+        assert manager.remove_subscriber("pod-a")
+        assert not manager.remove_subscriber("pod-a")
+        manager.shutdown()
+        assert manager.active_pods() == []
+
+
+def test_end_to_end_publish_subscribe_score():
+    endpoint = "tcp://127.0.0.1:15782"
+    index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+    db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+    pool = Pool(index, db, PoolConfig(concurrency=2))
+    pool.start()
+
+    manager = SubscriberManager(sink=pool.add_task)
+    manager.ensure_subscriber("pod-1", endpoint)
+
+    publisher = Publisher(endpoint, "pod-1", MODEL, bind=True)
+    try:
+        # Let the SUB connection + subscription propagate, then publish
+        # repeatedly until delivery is observed (PUB/SUB is lossy pre-join).
+        tokens = [1, 2, 3, 4, 5, 6, 7, 8]
+        expected = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, MODEL)
+        deadline = time.monotonic() + 30
+        found = {}
+        while time.monotonic() < deadline and len(found) < 2:
+            publisher.publish(
+                BlockStored(
+                    block_hashes=[0xA1, 0xA2],
+                    parent_block_hash=None,
+                    token_ids=tokens,
+                    block_size=4,
+                    medium="hbm",
+                )
+            )
+            time.sleep(0.2)
+            pool.drain()
+            found = index.lookup(expected)
+        assert set(found) == set(expected)
+        assert found[expected[0]][0].pod_identifier == "pod-1"
+        assert found[expected[0]][0].device_tier == "hbm"
+    finally:
+        publisher.close()
+        manager.shutdown()
+        pool.shutdown()
