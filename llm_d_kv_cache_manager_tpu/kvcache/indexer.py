@@ -1,0 +1,154 @@
+"""The Indexer: orchestration of the scoring read path.
+
+``get_pod_scores(prompt, model, pods)`` answers the scheduler's question —
+*which pod holds the longest consecutive prefix of this prompt's KV
+blocks?* — by composing the subsystem stack (reference:
+pkg/kvcache/indexer.go:124-165):
+
+    tokenize (pool + prefix store [+ chat render])
+      -> token chain -> request block keys (ChunkedTokenDatabase)
+      -> index lookup (pluggable backend)
+      -> longest-prefix tier-weighted score
+
+One ``Config`` composes every module's config with defaults, so embedding
+applications construct the whole stack from a single literal.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    Index,
+    IndexConfig,
+    new_index,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+    ChunkedTokenDatabase,
+    TokenProcessor,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
+    LongestPrefixScorer,
+    ScorerConfig,
+    new_scorer,
+)
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
+    ApplyChatTemplateRequest,
+    ChatTemplatingProcessor,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.lru_store import (
+    LRUStoreConfig,
+    LRUTokenStore,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+    CompositeTokenizer,
+    LocalFastTokenizer,
+    Tokenizer,
+    TransformersTokenizer,
+)
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
+
+logger = get_logger("kvcache.indexer")
+
+
+@dataclass
+class IndexerConfig:
+    prefix_store_config: LRUStoreConfig = field(default_factory=LRUStoreConfig)
+    token_processor_config: TokenProcessorConfig = field(
+        default_factory=TokenProcessorConfig
+    )
+    kvblock_index_config: IndexConfig = field(default_factory=IndexConfig)
+    scorer_config: ScorerConfig = field(default_factory=ScorerConfig)
+    tokenizers_pool_config: TokenizationPoolConfig = field(
+        default_factory=TokenizationPoolConfig
+    )
+    # Directory searched by the local tokenizer backend; None disables it.
+    local_tokenizers_dir: Optional[str] = None
+
+
+class Indexer:
+    """Composes the read-path stack; see module docstring."""
+
+    def __init__(
+        self,
+        config: Optional[IndexerConfig] = None,
+        token_processor: Optional[TokenProcessor] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        chat_processor: Optional[ChatTemplatingProcessor] = None,
+    ) -> None:
+        self.config = config or IndexerConfig()
+        self.token_processor = token_processor or ChunkedTokenDatabase(
+            self.config.token_processor_config
+        )
+        self.kv_block_index: Index = new_index(
+            self.config.kvblock_index_config
+        )
+        self.scorer: LongestPrefixScorer = new_scorer(
+            self.config.scorer_config
+        )
+        self.prefix_store = LRUTokenStore(self.config.prefix_store_config)
+        self.chat_processor = chat_processor or ChatTemplatingProcessor()
+
+        if tokenizer is None:
+            backends: List[Tokenizer] = []
+            if self.config.local_tokenizers_dir:
+                backends.append(
+                    LocalFastTokenizer(self.config.local_tokenizers_dir)
+                )
+            backends.append(TransformersTokenizer())
+            tokenizer = CompositeTokenizer(backends)
+        self.tokenization_pool = TokenizationPool(
+            tokenizer,
+            self.prefix_store,
+            self.config.tokenizers_pool_config,
+            chat_processor=self.chat_processor,
+        )
+
+    def run(self) -> None:
+        """Start background workers (idempotent)."""
+        self.tokenization_pool.start()
+
+    def shutdown(self) -> None:
+        self.tokenization_pool.shutdown()
+
+    def set_tokenizer(self, tokenizer: Tokenizer, model_name: str) -> None:
+        self.tokenization_pool.set_tokenizer(tokenizer, model_name)
+
+    def get_pod_scores(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Optional[Sequence[str]] = None,
+        render_req: Optional[ApplyChatTemplateRequest] = None,
+    ) -> Dict[str, float]:
+        """Score candidate pods for a prompt.
+
+        ``pod_identifiers`` filters the result; None/empty scores every pod
+        the index knows about.
+        """
+        tokens = self.tokenization_pool.tokenize(
+            prompt, model_name, render_req
+        )
+        trace(logger, "tokenized prompt to %d tokens", len(tokens))
+
+        block_keys = self.token_processor.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, tokens, model_name
+        )
+        if not block_keys:
+            return {}
+        trace(logger, "derived %d block keys", len(block_keys))
+
+        pod_set = set(pod_identifiers) if pod_identifiers else None
+        key_to_pods = self.kv_block_index.lookup(block_keys, pod_set)
+        scores = self.scorer.score(block_keys, key_to_pods)
+        logger.debug(
+            "scored %d pods over %d block keys", len(scores), len(block_keys)
+        )
+        return scores

@@ -1,0 +1,4 @@
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (  # noqa: F401
+    ApplyChatTemplateRequest,
+    ChatTemplatingProcessor,
+)

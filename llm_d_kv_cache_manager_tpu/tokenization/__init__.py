@@ -1,0 +1,11 @@
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (  # noqa: F401
+    TokenizationPool,
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (  # noqa: F401
+    CompositeTokenizer,
+    Encoding,
+    LocalFastTokenizer,
+    Tokenizer,
+    TransformersTokenizer,
+)

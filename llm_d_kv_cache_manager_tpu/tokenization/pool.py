@@ -1,0 +1,213 @@
+"""Tokenization worker pool: the prompt -> tokens stage of the read path.
+
+Sync (``tokenize`` blocks on a future) and async (``enqueue_tokenization``
+fire-and-forget, warming the prefix store) modes over a bounded queue and N
+worker threads, mirroring the reference pool's shape
+(pkg/tokenization/pool.go).
+
+Fast path: the prefix store resolves the prompt's cached prefix; a full
+tokenizer run happens only when coverage < ``min_prefix_overlap_ratio``
+(default 0.8).  Chat-completions requests are rendered to a prompt string
+first, after which special tokens are NOT re-added (the template already
+placed them — matching vLLM's serving behavior, pool.go:220-231).
+
+Failed tasks retry up to 3 times, then fail the caller.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
+    ApplyChatTemplateRequest,
+    ChatTemplatingProcessor,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.lru_store import (
+    LRUTokenStore,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import Tokenizer
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
+
+logger = get_logger("tokenization.pool")
+
+DEFAULT_WORKERS = 5
+DEFAULT_MIN_PREFIX_OVERLAP_RATIO = 0.8
+DEFAULT_MAX_RETRIES = 3
+
+
+@dataclass
+class TokenizationPoolConfig:
+    workers: int = DEFAULT_WORKERS
+    min_prefix_overlap_ratio: float = DEFAULT_MIN_PREFIX_OVERLAP_RATIO
+    max_retries: int = DEFAULT_MAX_RETRIES
+    queue_size: int = 10_000
+    model_name: str = ""
+
+
+@dataclass
+class _Task:
+    prompt: str
+    model_name: str
+    render_req: Optional[ApplyChatTemplateRequest]
+    future: Optional["Future[List[int]]"]
+    attempts: int = 0
+
+
+class TokenizationPool:
+    def __init__(
+        self,
+        tokenizer: Tokenizer,
+        prefix_store: LRUTokenStore,
+        config: Optional[TokenizationPoolConfig] = None,
+        chat_processor: Optional[ChatTemplatingProcessor] = None,
+    ) -> None:
+        self.config = config or TokenizationPoolConfig()
+        if self.config.workers <= 0:
+            raise ValueError("pool workers must be positive")
+        self._tokenizer = tokenizer
+        self._prefix_store = prefix_store
+        self._chat_processor = chat_processor or ChatTemplatingProcessor()
+        self._queue: "queue.Queue[Optional[_Task]]" = queue.Queue(
+            self.config.queue_size
+        )
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._started = False
+
+    def set_tokenizer(self, tokenizer: Tokenizer, model_name: str) -> None:
+        self._tokenizer = tokenizer
+        self.config.model_name = model_name
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            for i in range(self.config.workers):
+                thread = threading.Thread(
+                    target=self._worker,
+                    name=f"kvtpu-tokenize-{i}",
+                    daemon=True,
+                )
+                thread.start()
+                self._threads.append(thread)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if not self._started:
+                return
+            for _ in self._threads:
+                self._queue.put(None)
+            for thread in self._threads:
+                thread.join(timeout=10)
+            self._threads.clear()
+            self._started = False
+
+    def tokenize(
+        self,
+        prompt: str,
+        model_name: Optional[str] = None,
+        render_req: Optional[ApplyChatTemplateRequest] = None,
+        timeout: Optional[float] = 60.0,
+    ) -> List[int]:
+        """Synchronous tokenization through the pool."""
+        future: "Future[List[int]]" = Future()
+        self._submit(prompt, model_name, render_req, future)
+        return future.result(timeout=timeout)
+
+    def enqueue_tokenization(
+        self,
+        prompt: str,
+        model_name: Optional[str] = None,
+        render_req: Optional[ApplyChatTemplateRequest] = None,
+    ) -> None:
+        """Fire-and-forget: warm the prefix store off the hot path."""
+        self._submit(prompt, model_name, render_req, None)
+
+    def _submit(self, prompt, model_name, render_req, future) -> None:
+        self.start()
+        self._queue.put(
+            _Task(
+                prompt=prompt,
+                model_name=model_name or self.config.model_name,
+                render_req=render_req,
+                future=future,
+            )
+        )
+
+    def _worker(self) -> None:
+        while True:
+            task = self._queue.get()
+            try:
+                if task is None:
+                    return
+                self._run_task(task)
+            finally:
+                self._queue.task_done()
+
+    def _run_task(self, task: _Task) -> None:
+        # Retries run inline on this worker: re-enqueueing would block on a
+        # full queue (deadlocking the pool under backend outage) and could
+        # strand the task behind shutdown sentinels with its future
+        # forever pending.
+        while True:
+            try:
+                tokens = self._process(task)
+            except Exception as exc:  # noqa: BLE001 — retried below
+                task.attempts += 1
+                if task.attempts < self.config.max_retries:
+                    trace(
+                        logger,
+                        "tokenization attempt %d failed (%s); retrying",
+                        task.attempts,
+                        exc,
+                    )
+                    continue
+                logger.error(
+                    "tokenization failed after %d attempts: %s",
+                    task.attempts,
+                    exc,
+                )
+                if task.future is not None:
+                    task.future.set_exception(exc)
+                return
+            if task.future is not None:
+                task.future.set_result(tokens)
+            return
+
+    def _process(self, task: _Task) -> List[int]:
+        prompt = task.prompt
+        # vLLM adds special tokens to raw completion prompts but not to
+        # chat-rendered ones (the template already placed them).
+        add_special_tokens = True
+        if task.render_req is not None:
+            prompt = self._chat_processor.apply_chat_template(
+                task.model_name, task.render_req
+            )
+            add_special_tokens = False
+
+        tokens, overlap_ratio = (
+            self._prefix_store.find_longest_contained_tokens(
+                prompt, task.model_name
+            )
+        )
+        if overlap_ratio >= self.config.min_prefix_overlap_ratio:
+            trace(
+                logger,
+                "prefix-store fast path: %d tokens at %.2f coverage",
+                len(tokens),
+                overlap_ratio,
+            )
+            return tokens
+
+        encoding = self._tokenizer.encode(
+            prompt, task.model_name, add_special_tokens
+        )
+        self._prefix_store.add_tokenization(
+            prompt, encoding.tokens, encoding.offsets, task.model_name
+        )
+        return encoding.tokens

@@ -1,0 +1,7 @@
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.lru_store import (  # noqa: F401
+    LRUStoreConfig,
+    LRUTokenStore,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.trie_store import (  # noqa: F401
+    TrieTokenStore,
+)

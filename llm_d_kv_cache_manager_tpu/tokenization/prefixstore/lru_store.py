@@ -1,0 +1,121 @@
+"""Prefix store: amortizes tokenization on the scoring hot path.
+
+Prompts in a KV-aware fleet share long prefixes (system prompts, few-shot
+preambles).  The store caches *text-chunk -> tokens* so a new prompt's
+shared prefix resolves to tokens without running the tokenizer; only when
+coverage falls below the pool's overlap threshold does a full tokenization
+run.
+
+Design (capability parity: pkg/tokenization/prefixstore/lru_store.go):
+fixed-size text chunks, chained xxhash64 keyed on
+``little_endian(prev_hash) || chunk_bytes`` so a chunk's identity encodes
+its whole prefix; each block stores the tokens whose end offset falls
+inside the chunk; lookups walk the chain until the first miss and report
+the covered fraction of the prompt.
+
+This store is purely indexer-internal (no cross-system hash contract), so
+it chunks the UTF-8 *bytes* of the prompt and expects tokenizer offsets in
+byte units (see ``tokenization.tokenizers.Encoding``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import xxhash
+
+from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
+
+DEFAULT_CHUNK_BYTES = 256
+DEFAULT_MAX_BLOCKS = 500_000
+
+
+@dataclass
+class LRUStoreConfig:
+    cache_size: int = DEFAULT_MAX_BLOCKS
+    # Chunk size in bytes of UTF-8 prompt text.
+    block_size: int = DEFAULT_CHUNK_BYTES
+
+
+def _chain_hash(prev_hash: int, chunk: bytes) -> int:
+    digest = xxhash.xxh64()
+    digest.update(prev_hash.to_bytes(8, "little"))
+    digest.update(chunk)
+    return digest.intdigest()
+
+
+def _chain_seed(model_name: str) -> int:
+    """Root of the chunk chain.
+
+    Tokenizations from different models must never alias — the same text
+    tokenized by two vocabularies yields different tokens — so the chain is
+    rooted in the model name.
+    """
+    if not model_name:
+        return 0
+    return xxhash.xxh64(model_name.encode("utf-8")).intdigest()
+
+
+class LRUTokenStore:
+    def __init__(self, config: LRUStoreConfig | None = None) -> None:
+        self.config = config or LRUStoreConfig()
+        if self.config.block_size <= 0:
+            raise ValueError("block_size must be positive")
+        self._cache: LRUCache[int, Tuple[int, ...]] = LRUCache(
+            self.config.cache_size
+        )
+
+    def add_tokenization(
+        self,
+        prompt: str,
+        tokens: Sequence[int],
+        offsets: Sequence[Tuple[int, int]],
+        model_name: str = "",
+    ) -> None:
+        """Index a full tokenization of ``prompt``.
+
+        ``offsets[i]`` is the byte range of ``tokens[i]`` in the UTF-8
+        prompt.  A token belongs to the chunk its *end* offset falls in;
+        tokens straddling a boundary belong to the later chunk.
+        """
+        if not prompt or not tokens:
+            return
+        if len(tokens) != len(offsets):
+            raise ValueError("tokens and offsets length mismatch")
+
+        data = prompt.encode("utf-8")
+        size = self.config.block_size
+        prev_hash = _chain_seed(model_name)
+        token_idx = 0
+        for start in range(0, len(data) - size + 1, size):
+            end = start + size
+            prev_hash = _chain_hash(prev_hash, data[start:end])
+            block_tokens: List[int] = []
+            while token_idx < len(tokens) and offsets[token_idx][1] <= end:
+                block_tokens.append(tokens[token_idx])
+                token_idx += 1
+            self._cache.put(prev_hash, tuple(block_tokens))
+
+    def find_longest_contained_tokens(
+        self, prompt: str, model_name: str = ""
+    ) -> Tuple[List[int], float]:
+        """Walk the chunk chain until the first miss.
+
+        Returns the concatenated tokens of the matched chunks and the
+        fraction of the prompt's bytes they cover.
+        """
+        tokens: List[int] = []
+        data = prompt.encode("utf-8")
+        size = self.config.block_size
+        prev_hash = _chain_seed(model_name)
+        coverage = 0.0
+        for start in range(0, len(data) - size + 1, size):
+            end = start + size
+            prev_hash = _chain_hash(prev_hash, data[start:end])
+            block = self._cache.get(prev_hash)
+            if block is None:
+                break
+            tokens.extend(block)
+            coverage = end / len(data)
+        return tokens, coverage

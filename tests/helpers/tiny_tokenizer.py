@@ -1,0 +1,60 @@
+"""Builds a tiny word-level fast tokenizer entirely in-process.
+
+No network, no checked-in fixture files: the vocabulary is derived from the
+test corpus at call time and saved as a standard ``tokenizer.json`` that
+both the `tokenizers` and `transformers` loaders understand.
+"""
+
+from __future__ import annotations
+
+import os
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog . "
+    "pack my box with five dozen liquor jugs . "
+    "how vexingly quick daft zebras jump . "
+    "system : you are a helpful assistant . user says hello world"
+)
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message['role'] }}|> {{ message['content'] }} "
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+
+def build_fast_tokenizer():
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2}
+    for word in CORPUS.split():
+        if word not in vocab:
+            vocab[word] = len(vocab)
+    # Chat-template markers used by CHAT_TEMPLATE.
+    for marker in ("<|system|>", "<|user|>", "<|assistant|>"):
+        vocab[marker] = len(vocab)
+    tokenizer = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tokenizer.pre_tokenizer = pre_tokenizers.Whitespace()
+    return tokenizer
+
+
+def save_tokenizer_json(directory: str, model_name: str = "test-model") -> str:
+    """Save under ``<dir>/<model>/tokenizer.json``; returns the dir."""
+    model_dir = os.path.join(directory, model_name)
+    os.makedirs(model_dir, exist_ok=True)
+    build_fast_tokenizer().save(os.path.join(model_dir, "tokenizer.json"))
+    return directory
+
+
+def build_transformers_tokenizer(chat_template: str = CHAT_TEMPLATE):
+    from transformers import PreTrainedTokenizerFast
+
+    wrapped = PreTrainedTokenizerFast(
+        tokenizer_object=build_fast_tokenizer(),
+        unk_token="<unk>",
+        bos_token="<s>",
+        eos_token="</s>",
+    )
+    wrapped.chat_template = chat_template
+    return wrapped

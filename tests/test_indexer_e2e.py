@@ -1,0 +1,212 @@
+"""End-to-end indexer: KVEvents in -> pod scores out.
+
+The reference's e2e suite (tests/e2e/redis_mock/e2e_test.go) boots the real
+indexer with block_size=4 and injects synthetic events; this does the same
+with the whole Python stack wired together, sharing one token processor
+between the event pool (write path) and the indexer (read path).
+"""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    IndexConfig,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    Message,
+    Pool,
+    PoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
+    ApplyChatTemplateRequest,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+    LocalFastTokenizer,
+)
+from tests.helpers.tiny_tokenizer import (
+    build_transformers_tokenizer,
+    save_tokenizer_json,
+)
+
+MODEL = "test-model"
+BLOCK_SIZE = 4
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    tokenizer_dir = save_tokenizer_json(str(tmp_path), MODEL)
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE
+            ),
+            kvblock_index_config=IndexConfig(
+                in_memory_config=InMemoryIndexConfig(size=10_000)
+            ),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                workers=2, model_name=MODEL
+            ),
+        ),
+        tokenizer=LocalFastTokenizer(tokenizer_dir),
+    )
+    indexer.chat_processor.register_tokenizer(
+        MODEL, build_transformers_tokenizer()
+    )
+    indexer.run()
+    event_pool = Pool(
+        indexer.kv_block_index,
+        indexer.token_processor,
+        PoolConfig(concurrency=2),
+    )
+    event_pool.start()
+    yield indexer, event_pool
+    event_pool.shutdown()
+    indexer.shutdown()
+
+
+def publish_prompt_blocks(
+    indexer, event_pool, prompt, pod, medium="hbm", base_hash=0x1000
+):
+    """Simulate a pod storing every full block of `prompt`'s tokens."""
+    encoding = indexer.tokenization_pool._tokenizer.encode(
+        prompt, MODEL, True
+    )
+    tokens = encoding.tokens
+    n_blocks = len(tokens) // BLOCK_SIZE
+    engine_hashes = [base_hash + i for i in range(n_blocks)]
+    batch = EventBatch(
+        ts=1.0,
+        events=[
+            BlockStored(
+                block_hashes=engine_hashes,
+                parent_block_hash=None,
+                token_ids=tokens[: n_blocks * BLOCK_SIZE],
+                block_size=BLOCK_SIZE,
+                medium=medium,
+            )
+        ],
+    )
+    event_pool.add_task(
+        Message(
+            topic=f"kv@{pod}@{MODEL}",
+            payload=batch.encode(),
+            pod_identifier=pod,
+            model_name=MODEL,
+        )
+    )
+    event_pool.drain()
+    return engine_hashes, n_blocks
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog . " * 8
+
+
+class TestEndToEnd:
+    def test_miss_then_hit(self, stack):
+        indexer, event_pool = stack
+        assert indexer.get_pod_scores(PROMPT, MODEL, ["pod-1"]) == {}
+
+        _, n_blocks = publish_prompt_blocks(
+            indexer, event_pool, PROMPT, "pod-1"
+        )
+        scores = indexer.get_pod_scores(PROMPT, MODEL, ["pod-1"])
+        assert scores["pod-1"] == pytest.approx(float(n_blocks))
+
+    def test_prefix_reduction(self, stack):
+        """A shorter prompt sharing the prefix still hits."""
+        indexer, event_pool = stack
+        publish_prompt_blocks(indexer, event_pool, PROMPT, "pod-1")
+        short = PROMPT[: len(PROMPT) // 2]
+        scores = indexer.get_pod_scores(short, MODEL, ["pod-1"])
+        assert scores.get("pod-1", 0) > 0
+
+    def test_prefix_expansion_partial_score(self, stack):
+        """A longer prompt scores only the stored prefix blocks."""
+        indexer, event_pool = stack
+        _, n_blocks = publish_prompt_blocks(
+            indexer, event_pool, PROMPT, "pod-1"
+        )
+        longer = PROMPT + "pack my box with five dozen liquor jugs . " * 8
+        scores = indexer.get_pod_scores(longer, MODEL, ["pod-1"])
+        assert 0 < scores["pod-1"] <= n_blocks
+
+    def test_tier_weighting_prefers_hbm(self, stack):
+        indexer, event_pool = stack
+        publish_prompt_blocks(
+            indexer, event_pool, PROMPT, "pod-hbm", medium="hbm",
+            base_hash=0x1000,
+        )
+        publish_prompt_blocks(
+            indexer, event_pool, PROMPT, "pod-host", medium="host",
+            base_hash=0x2000,
+        )
+        scores = indexer.get_pod_scores(
+            PROMPT, MODEL, ["pod-hbm", "pod-host"]
+        )
+        assert scores["pod-hbm"] > scores["pod-host"] > 0
+
+    def test_eviction_clears_scores(self, stack):
+        indexer, event_pool = stack
+        engine_hashes, _ = publish_prompt_blocks(
+            indexer, event_pool, PROMPT, "pod-1"
+        )
+        batch = EventBatch(
+            ts=2.0, events=[BlockRemoved(block_hashes=engine_hashes)]
+        )
+        event_pool.add_task(
+            Message(
+                topic=f"kv@pod-1@{MODEL}",
+                payload=batch.encode(),
+                pod_identifier="pod-1",
+                model_name=MODEL,
+            )
+        )
+        event_pool.drain()
+        assert indexer.get_pod_scores(PROMPT, MODEL, ["pod-1"]) == {}
+
+    def test_pod_filter(self, stack):
+        indexer, event_pool = stack
+        publish_prompt_blocks(indexer, event_pool, PROMPT, "pod-1")
+        scores = indexer.get_pod_scores(PROMPT, MODEL, ["other-pod"])
+        assert scores == {}
+
+    def test_chat_completions_flow(self, stack):
+        indexer, event_pool = stack
+        render_req = ApplyChatTemplateRequest(
+            conversation=[
+                {"role": "system", "content": "you are a helpful assistant ."},
+                {"role": "user", "content": "hello world"},
+            ]
+        )
+        # Render once to learn the exact prompt the engine would see, and
+        # simulate the engine having stored those blocks.
+        rendered = indexer.chat_processor.apply_chat_template(
+            MODEL, render_req
+        )
+        publish_prompt_blocks(indexer, event_pool, rendered, "pod-chat")
+        scores = indexer.get_pod_scores(
+            "", MODEL, ["pod-chat"], render_req=render_req
+        )
+        assert scores.get("pod-chat", 0) > 0
+
+    def test_long_prompt(self, stack):
+        indexer, event_pool = stack
+        long_prompt = PROMPT * 12  # ~1000 tokens
+        _, n_blocks = publish_prompt_blocks(
+            indexer, event_pool, long_prompt, "pod-long"
+        )
+        assert n_blocks > 100
+        scores = indexer.get_pod_scores(long_prompt, MODEL, ["pod-long"])
+        assert scores["pod-long"] == pytest.approx(float(n_blocks))

@@ -1,0 +1,274 @@
+import pytest
+
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
+    ApplyChatTemplateRequest,
+    ChatTemplatingProcessor,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.lru_store import (
+    LRUStoreConfig,
+    LRUTokenStore,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.trie_store import (
+    TrieTokenStore,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+    CompositeTokenizer,
+    Encoding,
+    LocalFastTokenizer,
+    char_offsets_to_byte_offsets,
+)
+from tests.helpers.tiny_tokenizer import (
+    build_transformers_tokenizer,
+    save_tokenizer_json,
+)
+
+
+def test_char_to_byte_offsets_ascii_identity():
+    text = "hello world"
+    offsets = [(0, 5), (6, 11)]
+    assert char_offsets_to_byte_offsets(text, offsets) == offsets
+
+
+def test_char_to_byte_offsets_multibyte():
+    text = "héllo"  # é is 2 bytes
+    assert char_offsets_to_byte_offsets(text, [(0, 5)]) == [(0, 6)]
+    assert char_offsets_to_byte_offsets(text, [(2, 5)]) == [(3, 6)]
+
+
+class TestPrefixStores:
+    def make_tokenization(self, n_words=200):
+        words = [f"w{i:04d}" for i in range(n_words)]
+        prompt = " ".join(words)
+        tokens, offsets, pos = [], [], 0
+        for i, word in enumerate(words):
+            tokens.append(i)
+            offsets.append((pos, pos + len(word)))
+            pos += len(word) + 1
+        return prompt, tokens, offsets
+
+    @pytest.mark.parametrize("store_cls", ["lru", "trie"])
+    def test_full_prefix_roundtrip(self, store_cls):
+        prompt, tokens, offsets = self.make_tokenization()
+        store = (
+            LRUTokenStore(LRUStoreConfig(block_size=64))
+            if store_cls == "lru"
+            else TrieTokenStore()
+        )
+        store.add_tokenization(prompt, tokens, offsets)
+        found, ratio = store.find_longest_contained_tokens(prompt)
+        assert ratio > 0.9
+        assert found == tokens[: len(found)]
+        assert len(found) > 0.8 * len(tokens)
+
+    def test_lru_partial_prefix(self):
+        prompt, tokens, offsets = self.make_tokenization()
+        store = LRUTokenStore(LRUStoreConfig(block_size=64))
+        store.add_tokenization(prompt, tokens, offsets)
+        # A prompt sharing only the first half: coverage reflects the split.
+        half = prompt[: len(prompt) // 2] + " entirely different tail " * 20
+        found, ratio = store.find_longest_contained_tokens(half)
+        assert 0.0 < ratio < 0.6
+        assert found == tokens[: len(found)]
+
+    def test_lru_unknown_prompt_zero(self):
+        store = LRUTokenStore(LRUStoreConfig(block_size=64))
+        found, ratio = store.find_longest_contained_tokens("never seen " * 50)
+        assert found == [] and ratio == 0.0
+
+    def test_lru_rejects_mismatched_lengths(self):
+        store = LRUTokenStore()
+        with pytest.raises(ValueError):
+            store.add_tokenization("abc", [1, 2], [(0, 1)])
+
+    def test_lru_ignores_empty(self):
+        store = LRUTokenStore()
+        store.add_tokenization("", [], [])
+        store.add_tokenization("abc", [], [])
+
+    @pytest.mark.parametrize("store_cls", ["lru", "trie"])
+    def test_models_never_alias(self, store_cls):
+        """Tokens cached for model A must not serve model B's lookups."""
+        prompt, tokens, offsets = self.make_tokenization()
+        store = (
+            LRUTokenStore(LRUStoreConfig(block_size=64))
+            if store_cls == "lru"
+            else TrieTokenStore()
+        )
+        store.add_tokenization(prompt, tokens, offsets, "model-a")
+        found_b, ratio_b = store.find_longest_contained_tokens(
+            prompt, "model-b"
+        )
+        assert found_b == [] and ratio_b == 0.0
+        found_a, ratio_a = store.find_longest_contained_tokens(
+            prompt, "model-a"
+        )
+        assert ratio_a > 0.9 and found_a
+
+
+@pytest.fixture(scope="module")
+def local_tokenizer_dir(tmp_path_factory):
+    directory = tmp_path_factory.mktemp("tokenizers")
+    return save_tokenizer_json(str(directory), "test-model")
+
+
+class TestLocalFastTokenizer:
+    def test_encode_with_byte_offsets(self, local_tokenizer_dir):
+        tokenizer = LocalFastTokenizer(local_tokenizer_dir)
+        encoding = tokenizer.encode(
+            "the quick brown fox", "test-model", add_special_tokens=True
+        )
+        assert len(encoding.tokens) == 4
+        assert encoding.offsets[0] == (0, 3)
+        assert encoding.offsets[1] == (4, 9)
+
+    def test_missing_model_raises(self, local_tokenizer_dir):
+        tokenizer = LocalFastTokenizer(local_tokenizer_dir)
+        with pytest.raises(FileNotFoundError):
+            tokenizer.encode("x", "no-such-model", True)
+
+    def test_composite_fallback(self, local_tokenizer_dir):
+        class Broken:
+            def type(self):
+                return "broken"
+
+            def encode(self, *a):
+                raise RuntimeError("boom")
+
+        composite = CompositeTokenizer(
+            [Broken(), LocalFastTokenizer(local_tokenizer_dir)]
+        )
+        encoding = composite.encode("lazy dog", "test-model", True)
+        assert len(encoding.tokens) == 2
+
+    def test_composite_all_fail(self):
+        class Broken:
+            def type(self):
+                return "broken"
+
+            def encode(self, *a):
+                raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="all tokenizer backends"):
+            CompositeTokenizer([Broken()]).encode("x", "m", True)
+
+
+class CountingTokenizer:
+    """Wraps LocalFastTokenizer counting full-encode calls."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls = 0
+
+    def type(self):
+        return "counting"
+
+    def encode(self, prompt, model, add_special_tokens):
+        self.calls += 1
+        return self.inner.encode(prompt, model, add_special_tokens)
+
+
+class TestTokenizationPool:
+    def test_sync_tokenize_and_fast_path(self, local_tokenizer_dir):
+        counting = CountingTokenizer(LocalFastTokenizer(local_tokenizer_dir))
+        store = LRUTokenStore(LRUStoreConfig(block_size=16))
+        pool = TokenizationPool(
+            counting,
+            store,
+            TokenizationPoolConfig(workers=2, model_name="test-model"),
+        )
+        prompt = "the quick brown fox jumps over the lazy dog . " * 10
+        first = pool.tokenize(prompt)
+        assert counting.calls == 1
+        assert len(first) > 50
+        # Same prompt again: prefix store coverage >= 0.8, no new encode.
+        second = pool.tokenize(prompt)
+        assert counting.calls == 1
+        assert second == first[: len(second)]
+        pool.shutdown()
+
+    def test_async_enqueue_warms_store(self, local_tokenizer_dir):
+        counting = CountingTokenizer(LocalFastTokenizer(local_tokenizer_dir))
+        store = LRUTokenStore(LRUStoreConfig(block_size=16))
+        pool = TokenizationPool(
+            counting,
+            store,
+            TokenizationPoolConfig(workers=1, model_name="test-model"),
+        )
+        prompt = "pack my box with five dozen liquor jugs . " * 8
+        pool.enqueue_tokenization(prompt)
+        pool._queue.join()
+        found, ratio = store.find_longest_contained_tokens(
+            prompt, "test-model"
+        )
+        assert ratio >= 0.8
+        pool.shutdown()
+
+    def test_retries_then_fails(self):
+        class AlwaysBroken:
+            def type(self):
+                return "broken"
+
+            def encode(self, *a):
+                raise RuntimeError("flaky")
+
+        pool = TokenizationPool(
+            AlwaysBroken(),
+            LRUTokenStore(),
+            TokenizationPoolConfig(workers=1, max_retries=3, model_name="m"),
+        )
+        with pytest.raises(RuntimeError, match="flaky"):
+            pool.tokenize("some prompt")
+        pool.shutdown()
+
+
+class TestChatTemplating:
+    def test_render_and_tokenize_without_specials(self, local_tokenizer_dir):
+        processor = ChatTemplatingProcessor()
+        processor.register_tokenizer(
+            "test-model", build_transformers_tokenizer()
+        )
+        rendered = processor.apply_chat_template(
+            "test-model",
+            ApplyChatTemplateRequest(
+                conversation=[
+                    {"role": "system", "content": "you are a helpful assistant ."},
+                    {"role": "user", "content": "hello world"},
+                ]
+            ),
+        )
+        assert rendered.startswith("<|system|>")
+        assert rendered.rstrip().endswith("<|assistant|>")
+
+        pool = TokenizationPool(
+            LocalFastTokenizer(local_tokenizer_dir),
+            LRUTokenStore(LRUStoreConfig(block_size=16)),
+            TokenizationPoolConfig(workers=1, model_name="test-model"),
+            chat_processor=processor,
+        )
+        tokens = pool.tokenize(
+            "",
+            render_req=ApplyChatTemplateRequest(
+                conversation=[{"role": "user", "content": "hello world"}]
+            ),
+        )
+        assert len(tokens) >= 3  # <|user|> hello world <|assistant|>
+        pool.shutdown()
+
+    def test_explicit_template_override(self):
+        processor = ChatTemplatingProcessor()
+        processor.register_tokenizer(
+            "test-model", build_transformers_tokenizer()
+        )
+        rendered = processor.apply_chat_template(
+            "test-model",
+            ApplyChatTemplateRequest(
+                conversation=[{"role": "user", "content": "hi"}],
+                chat_template="{{ messages[0]['content'] }}!",
+                add_generation_prompt=False,
+            ),
+        )
+        assert rendered == "hi!"
