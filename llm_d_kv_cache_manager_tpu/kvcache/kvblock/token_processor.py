@@ -74,7 +74,11 @@ class TokenProcessor(Protocol):
 class ChunkedTokenDatabase:
     """Chunked, chained block hashing compatible with the fleet's engines."""
 
-    def __init__(self, config: Optional[TokenProcessorConfig] = None) -> None:
+    def __init__(
+        self,
+        config: Optional[TokenProcessorConfig] = None,
+        use_native: bool = True,
+    ) -> None:
         self.config = config or TokenProcessorConfig()
         if self.config.block_size <= 0:
             raise ValueError(
@@ -83,6 +87,20 @@ class ChunkedTokenDatabase:
         self._init_hash = fnv1a_64(self.config.hash_seed.encode("utf-8"))
         # Per-model chain roots are deterministic; memoize them.
         self._model_init_cache: dict = {}
+        self._native_chain = None
+        if use_native:
+            try:
+                from llm_d_kv_cache_manager_tpu.native import get_library
+                from llm_d_kv_cache_manager_tpu.native.engine import (
+                    native_hash_chain,
+                )
+
+                # Trigger the (possibly slow) first build here at
+                # construction, not inside the first scoring request.
+                if get_library() is not None:
+                    self._native_chain = native_hash_chain
+            except Exception:  # no compiler / import issue: pure Python
+                self._native_chain = None
 
     @property
     def block_size(self) -> int:
@@ -116,8 +134,13 @@ class ChunkedTokenDatabase:
             prefix = self.model_init_hash(model_name)
 
         size = self.config.block_size
+        if self._native_chain is not None:
+            keys = self._native_chain(prefix, tokens, size)
+            if keys is not None:
+                return keys
+
         n_chunks = len(tokens) // size
-        keys: List[int] = []
+        keys = []
         for i in range(n_chunks):
             chunk = tokens[i * size : (i + 1) * size]
             prefix = self.chunk_hash(prefix, chunk, None)

@@ -1,0 +1,78 @@
+"""Builds libkvtpu_native.so with g++ (no CUDA, no external deps).
+
+Usage: ``python -m llm_d_kv_cache_manager_tpu.native.build [--force]``.
+The library lands next to this file and is picked up by the ctypes loader;
+callers that find no compiler fall back to pure Python transparently.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_SRC_FILES = ["hashing.cpp", "numa.cpp", "thread_pool.cpp", "file_io.cpp", "engine.cpp"]
+
+LIB_NAME = "libkvtpu_native.so"
+
+
+def _paths():
+    here = os.path.dirname(os.path.abspath(__file__))
+    src_dir = os.path.join(here, "src")
+    return here, src_dir, os.path.join(here, LIB_NAME)
+
+
+def lib_path() -> str:
+    return _paths()[2]
+
+
+def needs_build() -> bool:
+    here, src_dir, lib = _paths()
+    if not os.path.exists(lib):
+        return True
+    lib_mtime = os.path.getmtime(lib)
+    sources = [os.path.join(src_dir, f) for f in _SRC_FILES]
+    sources.append(os.path.join(src_dir, "kvtpu_native.hpp"))
+    return any(os.path.getmtime(s) > lib_mtime for s in sources)
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the library; returns its path, or None if no compiler."""
+    here, src_dir, lib = _paths()
+    if not force and not needs_build():
+        return lib
+    compiler = shutil.which("g++") or shutil.which("c++")
+    if compiler is None:
+        return None
+    sources = [os.path.join(src_dir, f) for f in _SRC_FILES]
+    # Build into a temp file then rename: concurrent builders (e.g.
+    # parallel test workers) must never load a torn .so.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=here)
+    os.close(fd)
+    cmd = [
+        compiler, "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        "-Wall", "-Wextra", "-o", tmp, *sources,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, lib)
+    except subprocess.CalledProcessError as exc:
+        os.unlink(tmp)
+        raise RuntimeError(
+            f"native build failed:\n{exc.stderr}"
+        ) from exc
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return lib
+
+
+if __name__ == "__main__":
+    result = build(force="--force" in sys.argv)
+    if result is None:
+        print("no C++ compiler found; pure-Python fallback will be used")
+        sys.exit(1)
+    print(f"built {result}")

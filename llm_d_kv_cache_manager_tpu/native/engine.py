@@ -1,0 +1,292 @@
+"""Python surface of the native runtime.
+
+* ``native_hash_chain`` — drop-in accelerator for the token processor's
+  chunk hashing (used automatically when the library is available).
+* ``OffloadEngine`` — async host-buffer <-> file jobs on the NUMA-pinned
+  native I/O pool, with a pure-Python ThreadPoolExecutor fallback so the
+  connector works (slower) without a compiler.
+
+Buffers are passed as numpy arrays; the caller owns their lifetime until
+the job completes (enforced here by keeping references until harvest).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from enum import IntEnum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from llm_d_kv_cache_manager_tpu.native import get_library
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("native.engine")
+
+
+class JobStatus(IntEnum):
+    PENDING = 0
+    SUCCEEDED = 1
+    FAILED = 2
+    UNKNOWN = 3
+
+
+def native_hash_chain(
+    parent_hash: int, tokens: Sequence[int], block_size: int
+) -> Optional[List[int]]:
+    """Chunk-hash via the native library; None if it is unavailable."""
+    lib = get_library()
+    if lib is None:
+        return None
+    try:
+        token_array = np.asarray(tokens, dtype=np.uint32)
+    except (OverflowError, ValueError, TypeError):
+        # Out-of-range token ids: let the arbitrary-precision Python
+        # implementation handle them rather than wrap/crash here.
+        return None
+    n_chunks = len(token_array) // block_size
+    if n_chunks == 0:
+        return []
+    out = np.empty(n_chunks, dtype=np.uint64)
+    written = lib.kvtpu_hash_chain(
+        ctypes.c_uint64(parent_hash & 0xFFFFFFFFFFFFFFFF),
+        token_array.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        len(token_array),
+        block_size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return [int(v) for v in out[:written]]
+
+
+class _PythonEngine:
+    """Fallback job engine: ThreadPoolExecutor + Python file I/O."""
+
+    def __init__(self, n_threads: int) -> None:
+        self._executor = ThreadPoolExecutor(
+            max_workers=n_threads, thread_name_prefix="kvtpu-offload"
+        )
+        self._lock = threading.Lock()
+        self._jobs: Dict[int, List[Future]] = {}
+
+    @staticmethod
+    def _store_one(path: str, buffer: np.ndarray, skip_existing: bool) -> bool:
+        try:
+            if skip_existing and os.path.exists(path):
+                os.utime(path)
+                return True
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                f.write(buffer.tobytes())
+            os.replace(tmp, path)
+            return True
+        except OSError:
+            return False
+
+    @staticmethod
+    def _load_one(path: str, buffer: np.ndarray) -> bool:
+        try:
+            expected = buffer.nbytes
+            if os.path.getsize(path) != expected:
+                return False
+            with open(path, "rb") as f:
+                data = f.read(expected)
+            if len(data) != expected:
+                return False
+            flat = buffer.reshape(-1).view(np.uint8)
+            flat[:] = np.frombuffer(data, dtype=np.uint8)
+            return True
+        except OSError:
+            return False
+
+    def store(self, job_id, paths, buffers, skip_existing) -> None:
+        futures = [
+            self._executor.submit(self._store_one, p, b, skip_existing)
+            for p, b in zip(paths, buffers)
+        ]
+        with self._lock:
+            self._jobs[job_id] = futures
+
+    def load(self, job_id, paths, buffers) -> None:
+        futures = [
+            self._executor.submit(self._load_one, p, b)
+            for p, b in zip(paths, buffers)
+        ]
+        with self._lock:
+            self._jobs[job_id] = futures
+
+    def get_finished(self) -> List[Tuple[int, JobStatus]]:
+        finished = []
+        with self._lock:
+            done_ids = [
+                job_id
+                for job_id, futures in self._jobs.items()
+                if all(f.done() for f in futures)
+            ]
+            for job_id in done_ids:
+                futures = self._jobs.pop(job_id)
+                ok = all(f.result() for f in futures)
+                finished.append(
+                    (job_id, JobStatus.SUCCEEDED if ok else JobStatus.FAILED)
+                )
+        return finished
+
+    def wait(self, job_id) -> JobStatus:
+        with self._lock:
+            futures = self._jobs.pop(job_id, None)
+        if futures is None:
+            return JobStatus.UNKNOWN
+        ok = all(f.result() for f in futures)
+        return JobStatus.SUCCEEDED if ok else JobStatus.FAILED
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=True)
+
+
+class OffloadEngine:
+    """Async store/load of host numpy buffers to/from block files."""
+
+    def __init__(self, n_threads: int = 4, numa_node: int = -1) -> None:
+        self._lib = get_library()
+        self._buffers_lock = threading.Lock()
+        # Keep buffer references alive until their job is harvested.
+        self._live_buffers: Dict[int, list] = {}
+        if self._lib is not None:
+            self._handle = self._lib.kvtpu_engine_create(
+                n_threads, numa_node
+            )
+            self._fallback = None
+            logger.info(
+                "native offload engine: %d threads, numa_node=%d",
+                n_threads,
+                numa_node,
+            )
+        else:
+            self._handle = None
+            self._fallback = _PythonEngine(n_threads)
+            logger.info("python offload engine fallback: %d threads", n_threads)
+
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    def _pin(self, job_id: int, buffers: list) -> None:
+        with self._buffers_lock:
+            if job_id in self._live_buffers:
+                # Overwriting would drop the only references to buffers the
+                # native workers still touch (use-after-free).
+                raise ValueError(
+                    f"job id {job_id} is still in flight; ids must be "
+                    "unique until harvested"
+                )
+            self._live_buffers[job_id] = buffers
+
+    def _unpin(self, job_id: int) -> None:
+        with self._buffers_lock:
+            self._live_buffers.pop(job_id, None)
+
+    @staticmethod
+    def _marshal(paths, buffers):
+        n = len(paths)
+        path_array = (ctypes.c_char_p * n)(
+            *[p.encode() for p in paths]
+        )
+        ptr_array = (ctypes.c_void_p * n)(
+            *[b.ctypes.data_as(ctypes.c_void_p) for b in buffers]
+        )
+        size_array = (ctypes.c_size_t * n)(*[b.nbytes for b in buffers])
+        return path_array, ptr_array, size_array
+
+    def store(
+        self,
+        job_id: int,
+        paths: Sequence[str],
+        buffers: Sequence[np.ndarray],
+        skip_existing: bool = True,
+    ) -> None:
+        if len(paths) != len(buffers):
+            raise ValueError("paths/buffers length mismatch")
+        buffers = [np.ascontiguousarray(b) for b in buffers]
+        self._pin(job_id, buffers)
+        if self._fallback is not None:
+            self._fallback.store(job_id, paths, buffers, skip_existing)
+            return
+        path_array, ptr_array, size_array = self._marshal(paths, buffers)
+        self._lib.kvtpu_engine_store(
+            self._handle,
+            job_id,
+            path_array,
+            ptr_array,
+            size_array,
+            len(paths),
+            1 if skip_existing else 0,
+        )
+
+    def load(
+        self,
+        job_id: int,
+        paths: Sequence[str],
+        buffers: Sequence[np.ndarray],
+    ) -> None:
+        if len(paths) != len(buffers):
+            raise ValueError("paths/buffers length mismatch")
+        for buffer in buffers:
+            if not buffer.flags["C_CONTIGUOUS"] or not buffer.flags["WRITEABLE"]:
+                raise ValueError("load buffers must be contiguous+writeable")
+        buffers = list(buffers)
+        self._pin(job_id, buffers)
+        if self._fallback is not None:
+            self._fallback.load(job_id, paths, buffers)
+            return
+        path_array, ptr_array, size_array = self._marshal(paths, buffers)
+        self._lib.kvtpu_engine_load(
+            self._handle,
+            job_id,
+            path_array,
+            ptr_array,
+            size_array,
+            len(paths),
+        )
+
+    def get_finished(self, max_out: int = 1024) -> List[Tuple[int, JobStatus]]:
+        if self._fallback is not None:
+            finished = self._fallback.get_finished()
+        else:
+            job_ids = (ctypes.c_int64 * max_out)()
+            statuses = (ctypes.c_int32 * max_out)()
+            n = self._lib.kvtpu_engine_get_finished(
+                self._handle, job_ids, statuses, max_out
+            )
+            finished = [
+                (int(job_ids[i]), JobStatus(int(statuses[i])))
+                for i in range(n)
+            ]
+        for job_id, _ in finished:
+            self._unpin(job_id)
+        return finished
+
+    def wait(self, job_id: int) -> JobStatus:
+        if self._fallback is not None:
+            status = self._fallback.wait(job_id)
+        else:
+            status = JobStatus(
+                int(self._lib.kvtpu_engine_wait(self._handle, job_id))
+            )
+        self._unpin(job_id)
+        return status
+
+    def close(self) -> None:
+        if self._fallback is not None:
+            self._fallback.close()
+        elif self._handle is not None:
+            self._lib.kvtpu_engine_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

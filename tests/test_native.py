@@ -1,0 +1,189 @@
+"""Native engine tests: hash parity (C++ vs Python oracle) and offload
+job roundtrips for both the native and fallback engines."""
+
+import os
+
+import numpy as np
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.native import get_library
+from llm_d_kv_cache_manager_tpu.native.engine import (
+    JobStatus,
+    OffloadEngine,
+    native_hash_chain,
+)
+
+needs_native = pytest.mark.skipif(
+    get_library() is None, reason="native library unavailable"
+)
+
+
+@needs_native
+class TestNativeHashParity:
+    def test_fnv_parity(self):
+        lib = get_library()
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+            fnv1a_64,
+        )
+
+        for data in (b"", b"a", b"foobar", bytes(range(256))):
+            assert lib.kvtpu_fnv1a64(data, len(data)) == fnv1a_64(data)
+
+    @pytest.mark.parametrize("block_size", [1, 4, 16, 256])
+    @pytest.mark.parametrize("seed", ["", "42"])
+    def test_chain_parity_vs_python(self, block_size, seed):
+        """C++ chain must equal the pure-Python oracle bit for bit."""
+        config = TokenProcessorConfig(block_size=block_size, hash_seed=seed)
+        python_db = ChunkedTokenDatabase(config, use_native=False)
+        assert python_db._native_chain is None
+
+        rng = np.random.default_rng(7)
+        tokens = [int(t) for t in rng.integers(0, 2**32, size=1000)]
+        expected = python_db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, tokens, "model"
+        )
+        parent = python_db.model_init_hash("model")
+        native = native_hash_chain(parent, tokens, block_size)
+        assert native == expected
+
+    def test_native_wired_into_token_processor(self):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=16))
+        assert db._native_chain is not None
+        oracle = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=16), use_native=False
+        )
+        tokens = list(range(160))
+        assert db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, tokens, "m"
+        ) == oracle.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, "m")
+
+    def test_chain_parity_with_parent(self):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=8))
+        oracle = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=8), use_native=False
+        )
+        tokens = list(range(64))
+        parent = 0xDEADBEEF12345678
+        assert db.tokens_to_kv_block_keys(
+            parent, tokens, "m"
+        ) == oracle.tokens_to_kv_block_keys(parent, tokens, "m")
+
+
+@pytest.fixture(params=["native", "python"])
+def engine(request, monkeypatch):
+    if request.param == "native":
+        if get_library() is None:
+            pytest.skip("native library unavailable")
+        eng = OffloadEngine(n_threads=2)
+        assert eng.is_native
+    else:
+        monkeypatch.setenv("KVTPU_DISABLE_NATIVE", "1")
+        eng = OffloadEngine(n_threads=2)
+        assert not eng.is_native
+    yield eng
+    eng.close()
+
+
+class TestOffloadEngine:
+    def test_store_load_roundtrip(self, engine, tmp_path):
+        rng = np.random.default_rng(3)
+        blocks = [
+            rng.integers(0, 255, size=(2, 16, 8), dtype=np.uint8)
+            for _ in range(5)
+        ]
+        paths = [str(tmp_path / f"{i:02x}" / f"block_{i}.bin") for i in range(5)]
+        engine.store(1, paths, blocks, skip_existing=True)
+        assert engine.wait(1) == JobStatus.SUCCEEDED
+        for path in paths:
+            assert os.path.exists(path)
+
+        out = [np.zeros_like(b) for b in blocks]
+        engine.load(2, paths, out)
+        assert engine.wait(2) == JobStatus.SUCCEEDED
+        for original, loaded in zip(blocks, out):
+            np.testing.assert_array_equal(original, loaded)
+
+    def test_get_finished_harvests_once(self, engine, tmp_path):
+        data = np.arange(64, dtype=np.uint8)
+        engine.store(10, [str(tmp_path / "a.bin")], [data])
+        status = engine.wait(10)
+        assert status == JobStatus.SUCCEEDED
+        # wait() consumed the job; nothing left to harvest.
+        assert engine.get_finished() == []
+
+    def test_get_finished_polling(self, engine, tmp_path):
+        data = np.arange(128, dtype=np.uint8)
+        engine.store(20, [str(tmp_path / "b.bin")], [data])
+        import time
+
+        deadline = time.monotonic() + 10
+        finished = []
+        while time.monotonic() < deadline and not finished:
+            finished = engine.get_finished()
+            time.sleep(0.01)
+        assert finished == [(20, JobStatus.SUCCEEDED)]
+
+    def test_load_missing_file_fails(self, engine, tmp_path):
+        out = np.zeros(64, dtype=np.uint8)
+        engine.load(30, [str(tmp_path / "missing.bin")], [out])
+        assert engine.wait(30) == JobStatus.FAILED
+
+    def test_load_size_mismatch_fails(self, engine, tmp_path):
+        path = tmp_path / "short.bin"
+        path.write_bytes(b"x" * 10)
+        out = np.zeros(64, dtype=np.uint8)
+        engine.load(31, [str(path)], [out])
+        assert engine.wait(31) == JobStatus.FAILED
+
+    def test_skip_existing_dedupe(self, engine, tmp_path):
+        path = str(tmp_path / "dedupe.bin")
+        first = np.full(32, 1, dtype=np.uint8)
+        second = np.full(32, 2, dtype=np.uint8)
+        engine.store(40, [path], [first])
+        assert engine.wait(40) == JobStatus.SUCCEEDED
+        engine.store(41, [path], [second], skip_existing=True)
+        assert engine.wait(41) == JobStatus.SUCCEEDED
+        # Original content preserved: another pod's write was not clobbered.
+        assert open(path, "rb").read() == first.tobytes()
+
+    def test_overwrite_when_not_skipping(self, engine, tmp_path):
+        path = str(tmp_path / "clobber.bin")
+        first = np.full(32, 1, dtype=np.uint8)
+        second = np.full(32, 2, dtype=np.uint8)
+        engine.store(50, [path], [first])
+        assert engine.wait(50) == JobStatus.SUCCEEDED
+        engine.store(51, [path], [second], skip_existing=False)
+        assert engine.wait(51) == JobStatus.SUCCEEDED
+        assert open(path, "rb").read() == second.tobytes()
+
+    def test_wait_unknown_job(self, engine):
+        assert engine.wait(999) == JobStatus.UNKNOWN
+
+    def test_empty_job(self, engine):
+        engine.store(60, [], [])
+        assert engine.wait(60) in (JobStatus.SUCCEEDED, JobStatus.UNKNOWN)
+
+    def test_large_fanout(self, engine, tmp_path):
+        blocks = [
+            np.full(1024, i % 256, dtype=np.uint8) for i in range(64)
+        ]
+        paths = [str(tmp_path / f"fan_{i}.bin") for i in range(64)]
+        engine.store(70, paths, blocks)
+        assert engine.wait(70) == JobStatus.SUCCEEDED
+        out = [np.zeros(1024, dtype=np.uint8) for _ in range(64)]
+        engine.load(71, paths, out)
+        assert engine.wait(71) == JobStatus.SUCCEEDED
+        for i in range(64):
+            np.testing.assert_array_equal(out[i], blocks[i])
+
+
+def test_numa_detection_does_not_crash():
+    # NUMA topology may or may not exist in the test environment; the
+    # engine must construct either way.
+    eng = OffloadEngine(n_threads=1, numa_node=0)
+    eng.close()
