@@ -1,0 +1,4 @@
+from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import (  # noqa: F401
+    KVCachePool,
+    KVCachePoolConfig,
+)

@@ -76,6 +76,13 @@ def get_library() -> Optional[ctypes.CDLL]:
             if path is None:
                 return None
             _lib = _configure(ctypes.CDLL(path))
-        except (OSError, RuntimeError):
+        except (OSError, RuntimeError) as exc:
+            import logging
+
+            logging.getLogger("kvtpu.native").warning(
+                "native library unavailable (%s); using the slower "
+                "pure-Python fallback",
+                exc,
+            )
             _lib = None
         return _lib

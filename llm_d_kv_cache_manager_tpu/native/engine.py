@@ -42,10 +42,19 @@ def native_hash_chain(
     if lib is None:
         return None
     try:
-        token_array = np.asarray(tokens, dtype=np.uint32)
+        raw = np.asarray(tokens)
+        if not np.issubdtype(raw.dtype, np.integer):
+            return None
+        if raw.dtype != np.uint32 and raw.size and (
+            raw.min() < 0 or raw.max() > 0xFFFFFFFF
+        ):
+            # Out-of-range ids: an unsafe cast would wrap silently and
+            # diverge from the arbitrary-precision Python path.
+            return None
+        token_array = raw.astype(np.uint32, copy=False)
+        if not token_array.flags["C_CONTIGUOUS"]:
+            token_array = np.ascontiguousarray(token_array)
     except (OverflowError, ValueError, TypeError):
-        # Out-of-range token ids: let the arbitrary-precision Python
-        # implementation handle them rather than wrap/crash here.
         return None
     n_chunks = len(token_array) // block_size
     if n_chunks == 0:
@@ -173,6 +182,10 @@ class OffloadEngine:
     def is_native(self) -> bool:
         return self._handle is not None
 
+    def _check_open(self) -> None:
+        if self._fallback is None and self._handle is None:
+            raise RuntimeError("offload engine is closed")
+
     def _pin(self, job_id: int, buffers: list) -> None:
         with self._buffers_lock:
             if job_id in self._live_buffers:
@@ -209,6 +222,7 @@ class OffloadEngine:
     ) -> None:
         if len(paths) != len(buffers):
             raise ValueError("paths/buffers length mismatch")
+        self._check_open()
         buffers = [np.ascontiguousarray(b) for b in buffers]
         self._pin(job_id, buffers)
         if self._fallback is not None:
@@ -233,6 +247,7 @@ class OffloadEngine:
     ) -> None:
         if len(paths) != len(buffers):
             raise ValueError("paths/buffers length mismatch")
+        self._check_open()
         for buffer in buffers:
             if not buffer.flags["C_CONTIGUOUS"] or not buffer.flags["WRITEABLE"]:
                 raise ValueError("load buffers must be contiguous+writeable")
@@ -252,6 +267,7 @@ class OffloadEngine:
         )
 
     def get_finished(self, max_out: int = 1024) -> List[Tuple[int, JobStatus]]:
+        self._check_open()
         if self._fallback is not None:
             finished = self._fallback.get_finished()
         else:
@@ -269,6 +285,7 @@ class OffloadEngine:
         return finished
 
     def wait(self, job_id: int) -> JobStatus:
+        self._check_open()
         if self._fallback is not None:
             status = self._fallback.wait(job_id)
         else:

@@ -1,0 +1,12 @@
+from llm_d_kv_cache_manager_tpu.offload.file_mapper import FileMapper  # noqa: F401
+from llm_d_kv_cache_manager_tpu.offload.manager import (  # noqa: F401
+    SharedStorageOffloadManager,
+)
+from llm_d_kv_cache_manager_tpu.offload.spec import (  # noqa: F401
+    TPUOffloadConnector,
+    TPUOffloadSpec,
+)
+from llm_d_kv_cache_manager_tpu.offload.worker import (  # noqa: F401
+    DeviceToStorageHandler,
+    StorageToDeviceHandler,
+)

@@ -1,0 +1,57 @@
+"""Scheduler-side offload manager (runs on mesh-rank 0 only).
+
+Decides what to load/store against shared storage by probing the file
+layout — stateless, like the reference manager (kv_connectors/
+llmd_fs_backend/llmd_fs_backend/manager.py:44-103): lookup counts
+consecutive resident blocks from the start; stores are always accepted
+(shared storage does its own eviction); loads need no preparation.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional
+
+from llm_d_kv_cache_manager_tpu.offload.file_mapper import FileMapper
+
+
+@dataclass
+class PrepareStoreOutput:
+    block_hashes_to_store: List[int]
+    block_hashes_evicted: List[int] = field(default_factory=list)
+
+
+class SharedStorageOffloadManager:
+    def __init__(self, file_mapper: FileMapper) -> None:
+        self.file_mapper = file_mapper
+
+    def lookup(self, block_hashes: Iterable[int]) -> int:
+        """Consecutive-from-start resident block count."""
+        hits = 0
+        for block_hash in block_hashes:
+            if not os.path.exists(self.file_mapper.get_file_name(block_hash)):
+                break
+            hits += 1
+        return hits
+
+    def prepare_load(self, block_hashes: Iterable[int]) -> List[int]:
+        return list(block_hashes)
+
+    def complete_load(self, block_hashes: Iterable[int]) -> None:
+        pass
+
+    def touch(self, block_hashes: Iterable[int]) -> None:
+        # Recency refresh happens on the I/O threads during store-dedupe
+        # (native engine touch path) to keep this scheduler call cheap.
+        pass
+
+    def prepare_store(
+        self, block_hashes: Iterable[int]
+    ) -> Optional[PrepareStoreOutput]:
+        return PrepareStoreOutput(block_hashes_to_store=list(block_hashes))
+
+    def complete_store(
+        self, block_hashes: Iterable[int], success: bool = True
+    ) -> None:
+        pass

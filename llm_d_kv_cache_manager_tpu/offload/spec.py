@@ -1,0 +1,124 @@
+"""Connector assembly: config -> manager + handlers + file mapper.
+
+Parity with the reference's ``SharedStorageOffloadingSpec`` (kv_connectors/
+llmd_fs_backend/llmd_fs_backend/spec.py:36-117): reads the connector
+config, validates the offloaded-block geometry (offloaded block size must
+be a whole multiple of the device block size), builds the FileMapper keyed
+by model/geometry/mesh-axes/rank/dtype, and hands the scheduler a manager
+(rank 0 only) and the workers their transfer handlers.
+
+The mesh axes (tp/pp/pcp sizes and this worker's rank) come from the JAX
+device mesh instead of torch.distributed world info.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import KVCachePool
+from llm_d_kv_cache_manager_tpu.native.engine import OffloadEngine
+from llm_d_kv_cache_manager_tpu.offload.file_mapper import FileMapper
+from llm_d_kv_cache_manager_tpu.offload.manager import (
+    SharedStorageOffloadManager,
+)
+from llm_d_kv_cache_manager_tpu.offload.worker import (
+    DeviceToStorageHandler,
+    StorageToDeviceHandler,
+    StoreEventSink,
+)
+
+
+@dataclass
+class TPUOffloadSpec:
+    """Connector configuration (the ``--kv-transfer-config`` analogue)."""
+
+    shared_storage_path: str
+    model_name: str
+    # Tokens per device KV block.
+    device_block_size: int = 16
+    # Tokens per offloaded block (one file); must be a whole multiple of
+    # device_block_size.
+    offloaded_block_size: int = 64
+    threads_per_chip: int = 4
+    numa_node: int = -1
+    dtype: str = "bfloat16"
+    tp_size: int = 1
+    pp_size: int = 1
+    pcp_size: int = 1
+    rank: int = 0
+
+    def __post_init__(self) -> None:
+        if self.offloaded_block_size % self.device_block_size != 0:
+            raise ValueError(
+                "offloaded_block_size must be a multiple of "
+                f"device_block_size ({self.offloaded_block_size} % "
+                f"{self.device_block_size} != 0)"
+            )
+
+    @property
+    def blocks_per_file(self) -> int:
+        return self.offloaded_block_size // self.device_block_size
+
+
+class TPUOffloadConnector:
+    """One per worker process; scheduler rank additionally gets a manager."""
+
+    def __init__(
+        self,
+        spec: TPUOffloadSpec,
+        pool: KVCachePool,
+        event_sink: Optional[StoreEventSink] = None,
+    ) -> None:
+        if pool.config.block_size != spec.device_block_size:
+            raise ValueError(
+                f"pool block_size {pool.config.block_size} != spec "
+                f"device_block_size {spec.device_block_size}; the storage "
+                "layout would advertise a geometry the files don't have"
+            )
+        if pool.config.dtype != spec.dtype:
+            raise ValueError(
+                f"pool dtype {pool.config.dtype!r} != spec dtype "
+                f"{spec.dtype!r}"
+            )
+        self.spec = spec
+        self.file_mapper = FileMapper(
+            root_dir=spec.shared_storage_path,
+            model_name=spec.model_name,
+            device_block_size=spec.device_block_size,
+            blocks_per_file=spec.blocks_per_file,
+            tp_size=spec.tp_size,
+            pp_size=spec.pp_size,
+            pcp_size=spec.pcp_size,
+            rank=spec.rank,
+            dtype=spec.dtype,
+        )
+        self.engine = OffloadEngine(
+            n_threads=spec.threads_per_chip, numa_node=spec.numa_node
+        )
+        self.store_handler = DeviceToStorageHandler(
+            pool, self.engine, self.file_mapper, event_sink=event_sink
+        )
+        self.load_handler = StorageToDeviceHandler(
+            pool, self.engine, self.file_mapper
+        )
+
+    def get_manager(self) -> SharedStorageOffloadManager:
+        """Scheduler-side manager; call on mesh-rank 0 only."""
+        return SharedStorageOffloadManager(self.file_mapper)
+
+    def get_finished(self):
+        """Poll the shared engine once and route each completion to the
+        handler that owns the job (store-event emission / load scatter
+        happen here)."""
+        routed = []
+        for job_id, status in self.engine.get_finished():
+            for handler in (self.store_handler, self.load_handler):
+                if handler.owns(job_id):
+                    status = handler.on_finished(job_id, status)
+                    break
+            routed.append((job_id, status))
+        return routed
+
+    def close(self) -> None:
+        self.engine.close()

@@ -1,0 +1,197 @@
+"""Worker-side offload handlers: TPU HBM <-> shared storage.
+
+The store path is *one device gather + one DMA + async file fanout*: the
+handler gathers every requested block (all layers at once) into a single
+contiguous host array, slices per-file views, and hands them to the native
+I/O engine — replacing the reference's per-block-per-layer
+``cudaMemcpyAsync`` loop + CUDA-event fencing (storage_offload.cpp:145-239,
+tensor_copier.cu:50-97) with XLA's DMA engine.
+
+The load path is the mirror: async file reads into host buffers, then on
+completion one upload + jitted scatter into the cache pool.  Because the
+scatter must wait for the file bytes, loads finish at harvest time
+(``get_finished``/``wait``), keeping the serving step free of blocking I/O.
+
+File grouping: an offloaded block = ``blocks_per_file`` device blocks; the
+*first* file of a transfer may carry fewer (a partial group), mirroring the
+reference's grouping (worker.py:100-117).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import ml_dtypes  # ships with jax; registers bfloat16 as a numpy dtype
+import numpy as np
+
+
+def host_dtype(name: str) -> np.dtype:
+    """Numpy dtype for host staging buffers, incl. bf16 via ml_dtypes."""
+    if name == "bfloat16":
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import KVCachePool
+from llm_d_kv_cache_manager_tpu.native.engine import (
+    JobStatus,
+    OffloadEngine,
+)
+from llm_d_kv_cache_manager_tpu.offload.file_mapper import FileMapper
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("offload.worker")
+
+# (file_hash, device_block_ids) — one file per offloaded block group.
+FileBlockGroup = Tuple[int, Sequence[int]]
+
+# Called with (file_hashes, medium) when a store job lands, so the pod can
+# advertise the new tier in its KVEvents stream.
+StoreEventSink = Callable[[List[int], str], None]
+
+SHARED_STORAGE_MEDIUM = "shared_storage"
+
+
+def group_blocks_per_file(
+    file_hashes: Sequence[int],
+    block_ids: Sequence[int],
+    blocks_per_file: int,
+) -> List[FileBlockGroup]:
+    """Group device block ids under their file hashes.
+
+    The first group may be partial (when the transfer starts mid-group);
+    all later groups are full.
+    """
+    if not file_hashes:
+        return []
+    remainder = len(block_ids) - (len(file_hashes) - 1) * blocks_per_file
+    if remainder <= 0 or remainder > blocks_per_file:
+        raise ValueError(
+            f"{len(block_ids)} blocks cannot split into {len(file_hashes)} "
+            f"files of up to {blocks_per_file}"
+        )
+    groups: List[FileBlockGroup] = []
+    cursor = 0
+    for i, file_hash in enumerate(file_hashes):
+        take = remainder if i == 0 else blocks_per_file
+        groups.append((file_hash, list(block_ids[cursor : cursor + take])))
+        cursor += take
+    return groups
+
+
+class _HandlerBase:
+    """Shared-engine handler.
+
+    Both handlers submit jobs to one engine, so raw ``engine.get_finished``
+    interleaves their completions; each handler claims only its own job ids
+    via ``owns``/``on_finished``, and the connector routes the harvest.
+    Job ids must be unique across the connector.
+    """
+
+    def __init__(
+        self,
+        pool: KVCachePool,
+        engine: OffloadEngine,
+        file_mapper: FileMapper,
+    ) -> None:
+        self.pool = pool
+        self.engine = engine
+        self.file_mapper = file_mapper
+
+    def owns(self, job_id: int) -> bool:
+        raise NotImplementedError
+
+    def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
+        """Completion hook; returns the (possibly updated) status."""
+        raise NotImplementedError
+
+    def wait(self, job_id: int) -> JobStatus:
+        return self.on_finished(job_id, self.engine.wait(job_id))
+
+
+class DeviceToStorageHandler(_HandlerBase):
+    """Asynchronously persist device blocks to shared storage."""
+
+    def __init__(self, *args, event_sink: Optional[StoreEventSink] = None):
+        super().__init__(*args)
+        self._event_sink = event_sink
+        self._job_hashes: Dict[int, List[int]] = {}
+
+    def transfer_async(
+        self, job_id: int, groups: Sequence[FileBlockGroup]
+    ) -> None:
+        all_ids: List[int] = []
+        for _, ids in groups:
+            all_ids.extend(ids)
+        # One gather + one DMA for the whole job.
+        host = self.pool.gather_to_host(all_ids)  # [L, n, 2, bs, h, d]
+
+        paths: List[str] = []
+        buffers: List[np.ndarray] = []
+        cursor = 0
+        for file_hash, ids in groups:
+            paths.append(self.file_mapper.get_file_name(file_hash))
+            chunk = host[:, cursor : cursor + len(ids)]
+            buffers.append(np.ascontiguousarray(chunk))
+            cursor += len(ids)
+        self._job_hashes[job_id] = [h for h, _ in groups]
+        self.engine.store(job_id, paths, buffers, skip_existing=True)
+
+    def owns(self, job_id: int) -> bool:
+        return job_id in self._job_hashes
+
+    def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
+        hashes = self._job_hashes.pop(job_id, None)
+        if (
+            status == JobStatus.SUCCEEDED
+            and hashes
+            and self._event_sink is not None
+        ):
+            self._event_sink(hashes, SHARED_STORAGE_MEDIUM)
+        return status
+
+
+class StorageToDeviceHandler(_HandlerBase):
+    """Asynchronously page blocks from shared storage into the pool."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        # job_id -> (device_block_ids, host buffers awaiting scatter)
+        self._pending: Dict[int, Tuple[List[int], List[np.ndarray]]] = {}
+
+    def transfer_async(
+        self, job_id: int, groups: Sequence[FileBlockGroup]
+    ) -> None:
+        c = self.pool.config
+        paths: List[str] = []
+        buffers: List[np.ndarray] = []
+        all_ids: List[int] = []
+        for file_hash, ids in groups:
+            paths.append(self.file_mapper.get_file_name(file_hash))
+            buffers.append(
+                np.empty(
+                    (
+                        c.num_layers,
+                        len(ids),
+                        2,
+                        c.block_size,
+                        c.num_kv_heads,
+                        c.head_dim,
+                    ),
+                    dtype=host_dtype(c.dtype),
+                )
+            )
+            all_ids.extend(ids)
+        self._pending[job_id] = (all_ids, buffers)
+        self.engine.load(job_id, paths, buffers)
+
+    def owns(self, job_id: int) -> bool:
+        return job_id in self._pending
+
+    def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
+        pending = self._pending.pop(job_id, None)
+        if pending is None or status != JobStatus.SUCCEEDED:
+            return status
+        block_ids, buffers = pending
+        host = np.concatenate(buffers, axis=1)
+        self.pool.scatter_from_host(block_ids, host)
+        return status

@@ -1,0 +1,225 @@
+"""TPU offload connector tests (CPU-executed: JAX arrays + real files)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import (
+    KVCachePool,
+    KVCachePoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.native.engine import JobStatus
+from llm_d_kv_cache_manager_tpu.offload.file_mapper import FileMapper
+from llm_d_kv_cache_manager_tpu.offload.manager import (
+    SharedStorageOffloadManager,
+)
+from llm_d_kv_cache_manager_tpu.offload.spec import (
+    TPUOffloadConnector,
+    TPUOffloadSpec,
+)
+from llm_d_kv_cache_manager_tpu.offload.worker import (
+    group_blocks_per_file,
+    host_dtype,
+)
+
+POOL_CONFIG = KVCachePoolConfig(
+    num_layers=3,
+    num_blocks=32,
+    block_size=8,
+    num_kv_heads=2,
+    head_dim=16,
+    dtype="bfloat16",
+)
+
+
+def make_connector(tmp_path, pool=None, event_sink=None):
+    spec = TPUOffloadSpec(
+        shared_storage_path=str(tmp_path),
+        model_name="llama-3-8b",
+        device_block_size=8,
+        offloaded_block_size=16,  # 2 device blocks per file
+        threads_per_chip=2,
+    )
+    pool = pool or KVCachePool(POOL_CONFIG)
+    return TPUOffloadConnector(spec, pool, event_sink=event_sink), pool
+
+
+class TestFileMapper:
+    def test_layout(self):
+        mapper = FileMapper(
+            root_dir="/shared",
+            model_name="org/model",
+            device_block_size=16,
+            blocks_per_file=4,
+            tp_size=2,
+            pp_size=2,
+            pcp_size=1,
+            rank=3,
+            dtype="bfloat16",
+        )
+        path = mapper.get_file_name(0xABCDEF0123456789)
+        assert path == (
+            "/shared/org/model/block_size_16_blocks_per_file_4/"
+            "tp_2_pp_size_2_pcp_size_1/rank_3/bfloat16/"
+            "abc/de/abcdef0123456789.bin"
+        )
+
+    def test_bytes_hash_little_endian(self):
+        mapper = FileMapper("/s", "m", 16, 1)
+        raw = (0x1122).to_bytes(8, "little")
+        assert mapper.get_file_name(raw) == mapper.get_file_name(0x1122)
+
+    def test_negative_wraps_to_uint64(self):
+        mapper = FileMapper("/s", "m", 16, 1)
+        assert "ffffffffffffffff" in mapper.get_file_name(-1)
+
+
+class TestGrouping:
+    def test_full_groups(self):
+        groups = group_blocks_per_file([1, 2], [10, 11, 12, 13], 2)
+        assert groups == [(1, [10, 11]), (2, [12, 13])]
+
+    def test_partial_first_group(self):
+        groups = group_blocks_per_file([1, 2], [11, 12, 13], 2)
+        assert groups == [(1, [11]), (2, [12, 13])]
+
+    def test_invalid_split_raises(self):
+        with pytest.raises(ValueError):
+            group_blocks_per_file([1, 2], [1, 2, 3, 4, 5], 2)
+        with pytest.raises(ValueError):
+            group_blocks_per_file([1, 2, 3], [1, 2], 2)
+
+    def test_empty(self):
+        assert group_blocks_per_file([], [], 4) == []
+
+
+def fill_pool_blocks(pool, block_ids, seed=0):
+    """Write recognizable data into pool blocks; returns host copies."""
+    rng = np.random.default_rng(seed)
+    c = pool.config
+    written = {}
+    for block_id in block_ids:
+        data = rng.standard_normal(
+            (c.num_layers, 2, c.block_size, c.num_kv_heads, c.head_dim)
+        ).astype(host_dtype(c.dtype))
+        pool.write_block(block_id, data)
+        written[block_id] = data
+    return written
+
+
+class TestStoreLoadRoundtrip:
+    def test_roundtrip_through_files(self, tmp_path):
+        connector, pool = make_connector(tmp_path)
+        block_ids = [3, 4, 7, 9]
+        written = fill_pool_blocks(pool, block_ids)
+
+        groups = group_blocks_per_file([0xA, 0xB], block_ids, 2)
+        connector.store_handler.transfer_async(1, groups)
+        assert connector.store_handler.wait(1) == JobStatus.SUCCEEDED
+
+        for file_hash in (0xA, 0xB):
+            assert os.path.exists(
+                connector.file_mapper.get_file_name(file_hash)
+            )
+
+        # Page into a *fresh* pool (simulates another pod or post-restart).
+        pool2 = KVCachePool(POOL_CONFIG)
+        connector2 = TPUOffloadConnector(connector.spec, pool2)
+        target_ids = [20, 21, 22, 23]
+        connector2.load_handler.transfer_async(
+            2, group_blocks_per_file([0xA, 0xB], target_ids, 2)
+        )
+        assert connector2.load_handler.wait(2) == JobStatus.SUCCEEDED
+
+        restored = pool2.gather_to_host(target_ids)  # [L, 4, 2, bs, h, d]
+        for i, block_id in enumerate(block_ids):
+            np.testing.assert_array_equal(
+                restored[:, i], written[block_id]
+            )
+        connector.close()
+        connector2.close()
+
+    def test_get_finished_routes_between_handlers(self, tmp_path):
+        events = []
+        connector, pool = make_connector(
+            tmp_path, event_sink=lambda hashes, medium: events.append(
+                (tuple(hashes), medium)
+            )
+        )
+        fill_pool_blocks(pool, [0, 1])
+        connector.store_handler.transfer_async(
+            10, group_blocks_per_file([0xC], [0, 1], 2)
+        )
+        deadline = time.monotonic() + 10
+        finished = []
+        while time.monotonic() < deadline and not finished:
+            finished = connector.get_finished()
+            time.sleep(0.01)
+        assert finished == [(10, JobStatus.SUCCEEDED)]
+        assert events == [((0xC,), "shared_storage")]
+
+        connector.load_handler.transfer_async(
+            11, group_blocks_per_file([0xC], [5, 6], 2)
+        )
+        deadline = time.monotonic() + 10
+        finished = []
+        while time.monotonic() < deadline and not finished:
+            finished = connector.get_finished()
+            time.sleep(0.01)
+        assert finished == [(11, JobStatus.SUCCEEDED)]
+        # Load scattered into blocks 5,6.
+        restored = pool.gather_to_host([5, 6])
+        original = pool.gather_to_host([0, 1])
+        np.testing.assert_array_equal(restored, original)
+        connector.close()
+
+    def test_load_missing_file_fails(self, tmp_path):
+        connector, pool = make_connector(tmp_path)
+        connector.load_handler.transfer_async(
+            20, group_blocks_per_file([0xDEAD], [1, 2], 2)
+        )
+        assert connector.load_handler.wait(20) == JobStatus.FAILED
+        connector.close()
+
+
+class TestManager:
+    def test_lookup_consecutive(self, tmp_path):
+        connector, pool = make_connector(tmp_path)
+        manager = connector.get_manager()
+        fill_pool_blocks(pool, [0, 1, 2, 3])
+        connector.store_handler.transfer_async(
+            1, group_blocks_per_file([0x1, 0x2], [0, 1, 2, 3], 2)
+        )
+        assert connector.store_handler.wait(1) == JobStatus.SUCCEEDED
+
+        assert manager.lookup([0x1, 0x2]) == 2
+        assert manager.lookup([0x1, 0x2, 0x3]) == 2
+        assert manager.lookup([0x3, 0x1, 0x2]) == 0  # gap at the start
+        assert manager.lookup([]) == 0
+
+        output = manager.prepare_store([0x5, 0x6])
+        assert output.block_hashes_to_store == [0x5, 0x6]
+        assert output.block_hashes_evicted == []
+        connector.close()
+
+
+class TestSpecValidation:
+    def test_block_geometry_must_divide(self, tmp_path):
+        with pytest.raises(ValueError):
+            TPUOffloadSpec(
+                shared_storage_path=str(tmp_path),
+                model_name="m",
+                device_block_size=16,
+                offloaded_block_size=24,
+            )
+
+    def test_blocks_per_file(self, tmp_path):
+        spec = TPUOffloadSpec(
+            shared_storage_path=str(tmp_path),
+            model_name="m",
+            device_block_size=16,
+            offloaded_block_size=64,
+        )
+        assert spec.blocks_per_file == 4
