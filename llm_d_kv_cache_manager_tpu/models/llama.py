@@ -1,0 +1,300 @@
+"""Flagship model: Llama-family decoder, TPU-first.
+
+The serving fleet in BASELINE.json runs Llama-3-8B on v5e; this module
+is that model family in idiomatic JAX — pure-function params pytree,
+``lax.scan`` over a stacked layer axis (one compiled layer body,
+compiler-friendly control flow), bf16 matmuls with f32 softmax/norm
+accumulation for the MXU, and PartitionSpecs over the canonical mesh
+axes (parallel/mesh.py):
+
+- params: layer axis over ``pp``, heads/ffn-hidden over ``tp``
+- activations: batch over ``dp``, sequence over ``sp``
+- serving KV state: the paged pool (models/kv_cache_pool.py), written
+  by prefill and read by ``paged_attention`` at decode — the compute
+  counterpart of the KV-block index the manager tracks fleet-wide.
+
+Capabilities: dense forward (training / scoring), paged prefill +
+decode (serving), ring-attention prefill for long context (ops/
+ring_attention.py), and a full train step (optax AdamW) used by the
+multi-chip dry run.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from llm_d_kv_cache_manager_tpu.ops.attention import causal_gqa_attention
+from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
+
+Params = Dict[str, Any]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1408
+    rope_theta: float = 500000.0
+    block_size: int = 16  # paged-KV block, matches the index block size
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256,
+            d_model=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=8,
+            d_ff=14336,
+        )
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    dtype = jnp.dtype(cfg.dtype)
+    L, D, H, Hkv, Dh, F = (
+        cfg.n_layers,
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+        cfg.d_ff,
+    )
+    keys = jax.random.split(rng, 8)
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5).astype(
+            dtype
+        )
+
+    return {
+        "embed": norm_init(keys[0], (cfg.vocab_size, D), D),
+        "layers": {
+            "ln1": jnp.ones((L, D), dtype),
+            "ln2": jnp.ones((L, D), dtype),
+            "wq": norm_init(keys[1], (L, D, H, Dh), D),
+            "wk": norm_init(keys[2], (L, D, Hkv, Dh), D),
+            "wv": norm_init(keys[3], (L, D, Hkv, Dh), D),
+            "wo": norm_init(keys[4], (L, H, Dh, D), H * Dh),
+            "w_gate": norm_init(keys[5], (L, D, F), D),
+            "w_up": norm_init(keys[6], (L, D, F), D),
+            "w_down": norm_init(keys[7], (L, F, D), F),
+        },
+        "ln_f": jnp.ones((D,), dtype),
+    }
+
+
+def param_pspecs(cfg: LlamaConfig) -> Params:
+    """PartitionSpec pytree matching init_params (axes: parallel/mesh)."""
+    return {
+        "embed": P(None, "tp"),
+        "layers": {
+            "ln1": P("pp", None),
+            "ln2": P("pp", None),
+            "wq": P("pp", None, "tp", None),
+            "wk": P("pp", None, "tp", None),
+            "wv": P("pp", None, "tp", None),
+            "wo": P("pp", "tp", None, None),
+            "w_gate": P("pp", None, "tp"),
+            "w_up": P("pp", None, "tp"),
+            "w_down": P("pp", "tp", None),
+        },
+        "ln_f": P(None),
+    }
+
+
+def _rms_norm(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    norm = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (norm * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """x: [B, T, H, D] (D even); positions: [B, T]."""
+    D = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    return jnp.concatenate(
+        (x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1
+    ).astype(x.dtype)
+
+
+def _mlp(x: jnp.ndarray, lp: Params) -> jnp.ndarray:
+    gate = jnp.einsum("btd,df->btf", x, lp["w_gate"])
+    up = jnp.einsum("btd,df->btf", x, lp["w_up"])
+    hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    return jnp.einsum("btf,fd->btd", hidden, lp["w_down"])
+
+
+def _qkv(x: jnp.ndarray, lp: Params, positions: jnp.ndarray, theta: float):
+    q = jnp.einsum("btd,dhk->bthk", x, lp["wq"])
+    k = jnp.einsum("btd,dhk->bthk", x, lp["wk"])
+    v = jnp.einsum("btd,dhk->bthk", x, lp["wv"])
+    return _rope(q, positions, theta), _rope(k, positions, theta), v
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Dense forward: tokens [B, T] -> logits [B, T, V]."""
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["ln1"])
+        q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
+        attn = causal_gqa_attention(q, k, v)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+        x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
+        return x, None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    x = _rms_norm(x, params["ln_f"])
+    # Tied embedding head; f32 logits for a stable softmax/loss.
+    return jnp.einsum(
+        "btd,vd->btv", x.astype(jnp.float32), params["embed"].astype(jnp.float32)
+    )
+
+
+def prefill_paged(
+    params: Params,
+    tokens: jnp.ndarray,
+    kv_pool: jnp.ndarray,
+    block_table: jnp.ndarray,
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Prefill writing per-layer K/V into the paged pool.
+
+    tokens: [B, T] with T % block_size == 0 (pad; padding blocks may be
+    overwritten — give padded sequences scratch block ids).
+    kv_pool: [L, num_blocks, 2, block_size, Hkv, Dh] (KVCachePool.kv).
+    block_table: [B, T/block_size] pool block ids for each sequence.
+    Returns (logits [B, T, V], new kv_pool).
+    """
+    B, T = tokens.shape
+    nb = T // cfg.block_size
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    def layer(x, inputs):
+        lp, kv_layer = inputs
+        h = _rms_norm(x, lp["ln1"])
+        q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
+        attn = causal_gqa_attention(q, k, v)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+        x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
+        # [B, T, Hkv, Dh] -> [B, nb, block, Hkv, Dh] -> pool scatter
+        kv = jnp.stack((k, v), axis=2)  # [B, T, 2, Hkv, Dh]
+        kv = kv.reshape(B, nb, cfg.block_size, 2, kv.shape[-2], kv.shape[-1])
+        kv = kv.transpose(0, 1, 3, 2, 4, 5)  # [B, nb, 2, block, Hkv, Dh]
+        kv_layer = kv_layer.at[block_table.reshape(-1)].set(
+            kv.reshape((-1,) + kv.shape[2:]).astype(kv_layer.dtype)
+        )
+        return x, kv_layer
+
+    x, kv_pool = lax.scan(layer, x, (params["layers"], kv_pool))
+    x = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "btd,vd->btv", x.astype(jnp.float32), params["embed"].astype(jnp.float32)
+    )
+    return logits, kv_pool
+
+
+def decode_step(
+    params: Params,
+    tokens: jnp.ndarray,
+    kv_pool: jnp.ndarray,
+    block_table: jnp.ndarray,
+    context_len: jnp.ndarray,
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One decode step over the paged pool.
+
+    tokens: [B] current token ids; context_len: [B] length *including*
+    the current token; block_table: [B, max_blocks].  Writes the new
+    token's K/V into the pool slot, attends over the table, and returns
+    (logits [B, V], new kv_pool).
+    """
+    B = tokens.shape[0]
+    pos = context_len - 1  # [B]
+    x = jnp.take(params["embed"], tokens, axis=0)  # [B, D]
+    block_idx = pos // cfg.block_size
+    slot = pos % cfg.block_size
+    block_ids = jnp.take_along_axis(
+        block_table, block_idx[:, None], axis=1
+    )[:, 0]
+
+    def layer(x, inputs):
+        lp, kv_layer = inputs
+        h = _rms_norm(x, lp["ln1"])
+        h3 = h[:, None]  # [B, 1, D]
+        q, k, v = _qkv(h3, lp, pos[:, None], cfg.rope_theta)
+        kv_new = jnp.stack((k[:, 0], v[:, 0]), axis=1)  # [B, 2, Hkv, Dh]
+        kv_layer = kv_layer.at[block_ids, :, slot].set(
+            kv_new.astype(kv_layer.dtype)
+        )
+        attn = paged_attention(q[:, 0], kv_layer, block_table, context_len)
+        x = x + jnp.einsum("bhk,hkd->bd", attn, lp["wo"])
+        h2 = _rms_norm(x, lp["ln2"])[:, None]
+        x = x + _mlp(h2, lp)[:, 0]
+        return x, kv_layer
+
+    x, kv_pool = lax.scan(layer, x, (params["layers"], kv_pool))
+    x = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bd,vd->bv", x.astype(jnp.float32), params["embed"].astype(jnp.float32)
+    )
+    return logits, kv_pool
+
+
+# ---------------------------------------------------------------- training
+
+
+def loss_fn(
+    params: Params, tokens: jnp.ndarray, cfg: LlamaConfig
+) -> jnp.ndarray:
+    """Next-token cross entropy over tokens [B, T]."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
+
+
+def train_step(
+    params: Params,
+    opt_state: Any,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    optimizer: optax.GradientTransformation,
+) -> Tuple[Params, Any, jnp.ndarray]:
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss

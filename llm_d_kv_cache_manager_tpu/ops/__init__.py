@@ -1,0 +1,18 @@
+"""TPU compute ops: attention variants used by the serving stack.
+
+- ``attention``: dense causal GQA (prefill / training path).
+- ``ring_attention``: sequence-parallel blockwise attention over an
+  ``sp`` mesh axis (ppermute ring over ICI) for long-context prefill.
+- ``paged_attention``: decode-time attention over the paged KV pool
+  (block-table gather), the TPU analogue of vLLM's paged attention.
+"""
+
+from llm_d_kv_cache_manager_tpu.ops.attention import causal_gqa_attention
+from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
+from llm_d_kv_cache_manager_tpu.ops.ring_attention import ring_attention
+
+__all__ = [
+    "causal_gqa_attention",
+    "ring_attention",
+    "paged_attention",
+]

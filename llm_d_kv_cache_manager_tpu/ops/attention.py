@@ -1,0 +1,55 @@
+"""Dense causal grouped-query attention.
+
+The dense path used for training steps and short-prompt prefill.  Kept
+as one einsum-shaped function so XLA maps the contractions onto the MXU
+and fuses the softmax; no hand scheduling.  Accumulation is float32
+regardless of input dtype (bf16 in, f32 softmax, bf16 out).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def causal_gqa_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    q_offset: int | jnp.ndarray = 0,
+    kv_len: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Causal attention with grouped KV heads.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, Hkv, D] with H % Hkv == 0.
+    ``q_offset`` is the absolute position of q[0] within the key axis
+    (decode: Tq=1, q_offset=context_len-1).  ``kv_len`` ([B]) masks
+    padded keys beyond each sequence's real length.
+    Returns [B, Tq, H, D] in q.dtype.
+    """
+    B, Tq, H, D = q.shape
+    _, Tk, Hkv, _ = k.shape
+    groups = H // Hkv
+
+    qf = q.astype(jnp.float32) * (D**-0.5)
+    qf = qf.reshape(B, Tq, Hkv, groups, D)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k.astype(jnp.float32))
+
+    q_pos = jnp.arange(Tq)[:, None] + q_offset
+    k_pos = jnp.arange(Tk)[None, :]
+    mask = k_pos <= q_pos  # [Tq, Tk]
+    if kv_len is not None:
+        mask = mask[None] & (k_pos[None] < kv_len[:, None, None])  # [B,Tq,Tk]
+        mask = mask[:, None, None]  # [B,1,1,Tq,Tk]
+    else:
+        mask = mask[None, None, None]
+    scores = jnp.where(mask, scores, NEG_INF)
+
+    weights = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    weights = weights / weights.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, v.astype(jnp.float32))
+    return out.reshape(B, Tq, H, D).astype(q.dtype)

@@ -1,0 +1,122 @@
+"""Ring attention: sequence-parallel causal attention over an ``sp``
+mesh axis.
+
+Long-context prefill shards the sequence across devices; each device
+holds a contiguous chunk of Q/K/V.  K/V chunks rotate around the ring
+via ``lax.ppermute`` (one ICI hop per step) while each device keeps an
+online-softmax accumulator for its local queries — flash-attention
+semantics distributed over the mesh, compute overlapping the permute.
+
+This is the TPU-native answer to the reference's "long prompts stream
+through chunked hashing" scope note (SURVEY §2.3): here long prompts
+also *compute* in chunks, across chips.  Use under ``shard_map`` with
+q/k/v sharded on the sequence axis, or via ``ring_attention`` which
+wraps the shard_map given a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Per-device body. q/k/v: [B, T_local, H(kv), D]; causal over the
+    global sequence; chunk i of the ring holds positions
+    [i*T_local, (i+1)*T_local)."""
+    B, Tq, H, D = q.shape
+    _, Tk, Hkv, _ = k.shape
+    groups = H // Hkv
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32).reshape(B, Tq, Hkv, groups, D) * (D**-0.5)
+
+    # Derive accumulators from qf so they carry shard_map's
+    # varying-manual-axes type (a fresh jnp.zeros would not).
+    o = jnp.zeros_like(qf)
+    zero = jnp.zeros_like(qf[..., 0]).transpose(0, 2, 3, 1)  # [B,Hkv,g,Tq]
+    m = zero + NEG_INF
+    l = zero
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def accumulate(i, o, m, l, k_cur, v_cur):
+        src = (my_idx - i) % axis_size  # ring position k_cur came from
+
+        scores = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32)
+        )
+        q_pos = my_idx * Tq + jnp.arange(Tq)[:, None]
+        k_pos = src * Tk + jnp.arange(Tk)[None, :]
+        mask = k_pos <= q_pos  # [Tq, Tk] causal over global positions
+        scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # Keep exp() away from the -inf sentinel when a chunk is fully
+        # masked (fresh accumulator, future chunk): scale becomes exp(0).
+        m_safe = jnp.maximum(m_new, 0.5 * NEG_INF)
+        scale = jnp.exp(jnp.maximum(m, 0.5 * NEG_INF) - m_safe)
+        p = jnp.exp(scores - m_safe[..., None])
+        p = jnp.where(mask[None, None, None], p, 0.0)
+
+        l = l * scale + p.sum(axis=-1)
+        o = o * scale.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
+            "bhgqk,bkhd->bqhgd", p, v_cur.astype(jnp.float32)
+        )
+        return o, m_new, l
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        o, m, l = accumulate(i, o, m, l, k_cur, v_cur)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return o, m, l, k_next, v_next
+
+    # Last chunk accumulates outside the loop: no wasted final ppermute.
+    o, m, l, k_last, v_last = lax.fori_loop(
+        0, axis_size - 1, step, (o, m, l, k, v)
+    )
+    o, m, l = accumulate(axis_size - 1, o, m, l, k_last, v_last)
+    l = jnp.maximum(l, 1e-20)
+    o = o / l.transpose(0, 3, 1, 2)[..., None]
+    return o.reshape(B, Tq, H, D).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    batch_axis: Optional[str] = "dp",
+) -> jnp.ndarray:
+    """Shard q/k/v ([B, T, H, D]) on T over ``axis_name`` (and B over
+    ``batch_axis`` if given) and run the ring. Head/dim axes replicated
+    over sp — shard heads over ``tp`` outside if combining tp×sp."""
+    bspec = batch_axis if batch_axis else None
+    spec = P(bspec, axis_name, None, None)
+    local = functools.partial(_ring_attention_local, axis_name=axis_name)
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    q = jax.device_put(q, NamedSharding(mesh, spec))
+    k = jax.device_put(k, NamedSharding(mesh, spec))
+    v = jax.device_put(v, NamedSharding(mesh, spec))
+    return fn(q, k, v)

@@ -1,0 +1,28 @@
+"""Device-mesh and sharding helpers (TPU-first SPMD layout).
+
+The serving fleet design point (BASELINE.json): each pod is a TPU slice
+running the model under a single jitted SPMD program over a
+`jax.sharding.Mesh`; the KV-cache manager stack above it is fleet-level
+control plane.  This package owns the mesh/axis conventions shared by
+the model, the paged KV pool, and the offload connector.
+"""
+
+from llm_d_kv_cache_manager_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    MeshPlan,
+    make_mesh,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_PP",
+    "AXIS_TP",
+    "AXIS_SP",
+    "AXIS_EP",
+    "MeshPlan",
+    "make_mesh",
+]

@@ -1,0 +1,96 @@
+"""Mesh construction and axis conventions.
+
+Axis names (fixed across the framework so PartitionSpecs compose):
+
+- ``dp``: data parallel — batch axis; gradients all-reduced over it.
+- ``pp``: pipeline/stage axis — the stacked-layer axis of scanned
+  decoder params is sharded over it (XLA turns the layer scan over a
+  sharded leading axis into per-stage execution with collective
+  permutes of the activations between stages).
+- ``tp``: tensor parallel — attention heads and MLP hidden dim.
+- ``sp``: sequence/context parallel — long-context prefill shards the
+  sequence axis and runs ring attention over ``sp`` (ppermute over ICI).
+- ``ep``: expert parallel — reserved for MoE model families; meshes are
+  always built with the axis present (size 1 unless requested) so
+  PartitionSpecs mentioning it are valid everywhere.
+
+On real hardware ``jax.devices()`` for a TPU slice enumerates chips so
+that adjacent devices are ICI neighbours; we put ``sp``/``tp`` innermost
+so their collectives ride ICI, and ``dp`` outermost so it can span DCN
+(multi-host data parallelism), mirroring how the reference fleet scales
+pods over the datacenter network while NCCL stays intra-pod
+(reference: vllm-setup-helm topology; scaling-book mesh recipe).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_PP = "pp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+
+# Outermost-to-innermost device ordering; see module docstring.
+AXIS_ORDER: Tuple[str, ...] = (AXIS_DP, AXIS_PP, AXIS_EP, AXIS_SP, AXIS_TP)
+
+
+@dataclass
+class MeshPlan:
+    """Requested parallelism degrees; -1 on ``dp`` means "absorb the
+    remaining devices" (the common fleet configuration)."""
+
+    dp: int = -1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {
+            AXIS_DP: self.dp,
+            AXIS_PP: self.pp,
+            AXIS_TP: self.tp,
+            AXIS_SP: self.sp,
+            AXIS_EP: self.ep,
+        }
+        fixed = 1
+        free_axes = [a for a, s in sizes.items() if s == -1]
+        for a, s in sizes.items():
+            if s != -1:
+                if s <= 0:
+                    raise ValueError(f"axis {a} has invalid size {s}")
+                fixed *= s
+        if len(free_axes) > 1:
+            raise ValueError("at most one axis may be -1")
+        if free_axes:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed product {fixed}"
+                )
+            sizes[free_axes[0]] = n_devices // fixed
+        else:
+            if fixed != n_devices:
+                raise ValueError(
+                    f"mesh plan wants {fixed} devices, have {n_devices}"
+                )
+        return sizes
+
+
+def make_mesh(
+    plan: Optional[MeshPlan] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with the framework's canonical axis order."""
+    devices = list(devices if devices is not None else jax.devices())
+    plan = plan or MeshPlan()
+    sizes = plan.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)

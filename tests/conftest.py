@@ -7,10 +7,22 @@ before JAX is imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment preselects a TPU platform
+# (e.g. a sitecustomize registering JAX_PLATFORMS=axon): tests validate
+# multi-chip sharding on the virtual 8-device mesh; the real chip is
+# reserved for bench.py.  The config.update path wins over an
+# already-registered backend as long as no computation has run yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The env var alone is NOT enough here: the axon sitecustomize calls
+# jax.config at interpreter start, and config beats env at backend
+# init.  Importing jax to update config is the only reliable override.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 os.environ.setdefault("TOKENIZERS_PARALLELISM", "false")
