@@ -118,12 +118,22 @@ class KVCachePool:
         return np.asarray(jax.device_get(gathered))
 
     def scatter_from_host(
-        self, block_ids: Sequence[int], blocks: np.ndarray
+        self,
+        block_ids: Sequence[int],
+        blocks: np.ndarray,
+        donate: bool = False,
     ) -> None:
-        """Upload a host block batch and scatter it into the pool."""
+        """Upload a host block batch and scatter it into the pool.
+
+        ``donate=True`` lets XLA reuse the old pool buffer (halves peak
+        HBM) but deletes it — only safe when no external reference to
+        ``self.kv`` exists (the serving loop holds one between steps,
+        so the connector's async load path must keep the default).
+        """
         ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
         uploaded = jnp.asarray(blocks, dtype=self.kv.dtype)
-        self.kv = _scatter_donated(self.kv, ids, uploaded)
+        scatter = _scatter_donated if donate else _scatter
+        self.kv = scatter(self.kv, ids, uploaded)
 
     def write_block(self, block_id: int, block: np.ndarray) -> None:
         """Test/demo helper: set one block's contents."""

@@ -77,9 +77,9 @@ def get_library() -> Optional[ctypes.CDLL]:
                 return None
             _lib = _configure(ctypes.CDLL(path))
         except (OSError, RuntimeError) as exc:
-            import logging
+            from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
-            logging.getLogger("kvtpu.native").warning(
+            get_logger("native").warning(
                 "native library unavailable (%s); using the slower "
                 "pure-Python fallback",
                 exc,

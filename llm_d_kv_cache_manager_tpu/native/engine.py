@@ -83,9 +83,18 @@ class _PythonEngine:
     @staticmethod
     def _store_one(path: str, buffer: np.ndarray, skip_existing: bool) -> bool:
         try:
-            if skip_existing and os.path.exists(path):
-                os.utime(path)
-                return True
+            if skip_existing:
+                # Dedupe only when the resident file covers at least our
+                # bytes; a smaller file is a partial (head) group and is
+                # upgraded by rewriting (file = head-k blocks of a
+                # group).  If the stat/touch races a sweeper delete,
+                # fall through and write the bytes we hold.
+                try:
+                    if os.path.getsize(path) >= buffer.nbytes:
+                        os.utime(path)
+                        return True
+                except OSError:
+                    pass
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
             with open(tmp, "wb") as f:
@@ -99,7 +108,9 @@ class _PythonEngine:
     def _load_one(path: str, buffer: np.ndarray) -> bool:
         try:
             expected = buffer.nbytes
-            if os.path.getsize(path) != expected:
+            # A partial load reads the head of a (possibly larger) group
+            # file; a file smaller than the request is a miss.
+            if os.path.getsize(path) < expected:
                 return False
             with open(path, "rb") as f:
                 data = f.read(expected)
@@ -160,6 +171,7 @@ class OffloadEngine:
 
     def __init__(self, n_threads: int = 4, numa_node: int = -1) -> None:
         self._lib = get_library()
+        self._closed = False
         self._buffers_lock = threading.Lock()
         # Keep buffer references alive until their job is harvested.
         self._live_buffers: Dict[int, list] = {}
@@ -183,7 +195,7 @@ class OffloadEngine:
         return self._handle is not None
 
     def _check_open(self) -> None:
-        if self._fallback is None and self._handle is None:
+        if self._closed:
             raise RuntimeError("offload engine is closed")
 
     def _pin(self, job_id: int, buffers: list) -> None:
@@ -296,6 +308,9 @@ class OffloadEngine:
         return status
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         if self._fallback is not None:
             self._fallback.close()
         elif self._handle is not None:

@@ -50,12 +50,15 @@ void OffloadEngine::store(int64_t job_id,
     const uint8_t* buffer = buffers[i];
     const size_t size = sizes[i];
     pool_.enqueue([this, job_id, job, path, buffer, size, skip_existing] {
-      bool ok = true;
-      if (skip_existing && file_exists(path)) {
-        // Another pod already persisted this block; refresh recency so
-        // storage sweepers keep it.
-        touch_file(path);
-      } else {
+      // Another pod already persisted this (or a larger) group:
+      // refresh recency so storage sweepers keep it.  A smaller file is
+      // a partial head group, upgraded by rewriting.  If the touch
+      // races a sweeper delete, fall through and write the bytes we
+      // already hold instead of failing the job.
+      bool ok = skip_existing &&
+                file_size(path) >= static_cast<int64_t>(size) &&
+                touch_file(path);
+      if (!ok) {
         ok = write_buffer_to_file(path, buffer, size);
       }
       finish_task(job_id, job, ok);

@@ -25,6 +25,13 @@ bool file_exists(const std::string& path) {
   return ::stat(path.c_str(), &st) == 0;
 }
 
+// -1 when the file does not exist.
+int64_t file_size(const std::string& path) {
+  struct stat st;
+  if (::stat(path.c_str(), &st) != 0) return -1;
+  return static_cast<int64_t>(st.st_size);
+}
+
 bool write_buffer_to_file(const std::string& path, const uint8_t* data,
                           size_t size) {
   namespace fs = std::filesystem;
@@ -62,7 +69,9 @@ bool read_buffer_from_file(const std::string& path, uint8_t* data,
                            size_t size) {
   struct stat st;
   if (::stat(path.c_str(), &st) != 0) return false;
-  if (static_cast<size_t>(st.st_size) != size) return false;
+  // Head-of-group semantics: a partial request reads the head of a
+  // (possibly larger) group file; a smaller file is a miss.
+  if (static_cast<size_t>(st.st_size) < size) return false;
   std::ifstream in(path, std::ios::binary);
   if (!in) return false;
   in.read(reinterpret_cast<char*>(data),
@@ -70,9 +79,11 @@ bool read_buffer_from_file(const std::string& path, uint8_t* data,
   return static_cast<size_t>(in.gcount()) == size;
 }
 
-void touch_file(const std::string& path) {
+bool touch_file(const std::string& path) {
   // nullptr = set both atime and mtime to now (matches os.utime()).
-  ::utimensat(AT_FDCWD, path.c_str(), nullptr, 0);
+  // False when the file vanished (store-dedupe racing a sweeper
+  // delete): the job must fail rather than advertise a gone block.
+  return ::utimensat(AT_FDCWD, path.c_str(), nullptr, 0) == 0;
 }
 
 }  // namespace kvtpu

@@ -89,11 +89,14 @@ bool read_buffer_from_file(const std::string& path, uint8_t* data,
 
 bool file_exists(const std::string& path);
 
+// Size in bytes, or -1 when the file does not exist.
+int64_t file_size(const std::string& path);
+
 // Refresh atime+mtime so recency-based sweepers on shared storage (and
 // noatime mounts) see recent use.  The reference intended atime-only but
 // actually updated mtime (file_io.cpp:143-148, noted doc/code mismatch);
 // we update both deliberately and match the Python fallback.
-void touch_file(const std::string& path);
+bool touch_file(const std::string& path);
 
 // ---------------------------------------------------------------------------
 // Offload engine

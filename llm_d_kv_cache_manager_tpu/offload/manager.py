@@ -23,14 +23,26 @@ class PrepareStoreOutput:
 
 
 class SharedStorageOffloadManager:
-    def __init__(self, file_mapper: FileMapper) -> None:
+    def __init__(
+        self, file_mapper: FileMapper, full_file_nbytes: Optional[int] = None
+    ) -> None:
         self.file_mapper = file_mapper
+        # Bytes of a full block-group file.  When known, lookup demands
+        # it: a smaller file is a partial (head) group whose tail blocks
+        # are not resident, and promising it to the scheduler would make
+        # the later load fail after the placement decision.
+        self.full_file_nbytes = full_file_nbytes
 
     def lookup(self, block_hashes: Iterable[int]) -> int:
         """Consecutive-from-start resident block count."""
         hits = 0
         for block_hash in block_hashes:
-            if not os.path.exists(self.file_mapper.get_file_name(block_hash)):
+            path = self.file_mapper.get_file_name(block_hash)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                break
+            if self.full_file_nbytes is not None and size < self.full_file_nbytes:
                 break
             hits += 1
         return hits
@@ -42,9 +54,18 @@ class SharedStorageOffloadManager:
         pass
 
     def touch(self, block_hashes: Iterable[int]) -> None:
-        # Recency refresh happens on the I/O threads during store-dedupe
-        # (native engine touch path) to keep this scheduler call cheap.
-        pass
+        """Refresh mtime so recency sweepers keep hot blocks.
+
+        Load-heavy fleets never re-store a popular prefix, and reads
+        don't move mtime (atime is dead on noatime mounts), so without
+        this the hottest blocks look coldest.  Best-effort: a vanished
+        file is simply skipped.
+        """
+        for block_hash in block_hashes:
+            try:
+                os.utime(self.file_mapper.get_file_name(block_hash))
+            except OSError:
+                pass
 
     def prepare_store(
         self, block_hashes: Iterable[int]

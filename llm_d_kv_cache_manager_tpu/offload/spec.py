@@ -81,7 +81,20 @@ class TPUOffloadConnector:
                 f"pool dtype {pool.config.dtype!r} != spec dtype "
                 f"{spec.dtype!r}"
             )
+        if len(pool.kv.sharding.device_set) > 1:
+            # Like the reference (one engine per rank over that rank's
+            # GPU tensors), each mesh rank runs its own connector over a
+            # single-device pool holding its KV shard, writing under its
+            # own rank_<r> path.  A multi-device pool here would make
+            # every rank gather and persist the full global array —
+            # rank-layout corruption, not just waste.
+            raise ValueError(
+                "pool spans multiple devices; run one connector per "
+                "mesh rank over that rank's local (single-device) pool "
+                "and set spec.rank accordingly"
+            )
         self.spec = spec
+        self.pool = pool
         self.file_mapper = FileMapper(
             root_dir=spec.shared_storage_path,
             model_name=spec.model_name,
@@ -105,7 +118,11 @@ class TPUOffloadConnector:
 
     def get_manager(self) -> SharedStorageOffloadManager:
         """Scheduler-side manager; call on mesh-rank 0 only."""
-        return SharedStorageOffloadManager(self.file_mapper)
+        return SharedStorageOffloadManager(
+            self.file_mapper,
+            full_file_nbytes=self.pool.block_nbytes
+            * self.spec.blocks_per_file,
+        )
 
     def get_finished(self):
         """Poll the shared engine once and route each completion to the

@@ -13,8 +13,18 @@ scatter must wait for the file bytes, loads finish at harvest time
 (``get_finished``/``wait``), keeping the serving step free of blocking I/O.
 
 File grouping: an offloaded block = ``blocks_per_file`` device blocks; the
-*first* file of a transfer may carry fewer (a partial group), mirroring the
-reference's grouping (worker.py:100-117).
+*last* file of a transfer may carry fewer (a partial tail group).  The
+reference puts the partial group first (worker.py:100-117); we deviate
+deliberately — prefix chains grow at the tail, and a tail-partial split is
+the only one coherent with head-of-file bytes (see layout below).
+
+File byte layout is **block-major**: ``[k, num_layers, 2, block_size,
+heads, dim]`` — each block's all-layer data contiguous, matching the
+reference's staging layout (tensor_copier.cu:50-97).  This is what makes
+partial groups coherent: the head ``k * block_nbytes`` bytes of a file
+ARE its first k blocks, so a partial store writes a valid prefix and a
+partial load reads one.  The pool's device layout is layer-major (one
+gather for all layers), so the host path transposes at the boundary.
 """
 
 from __future__ import annotations
@@ -58,8 +68,15 @@ def group_blocks_per_file(
 ) -> List[FileBlockGroup]:
     """Group device block ids under their file hashes.
 
-    The first group may be partial (when the transfer starts mid-group);
-    all later groups are full.
+    The LAST group may be partial; earlier groups are full.  This is the
+    prefix-caching shape — block chains grow at the tail, so a transfer
+    covers whole groups from its start and at most one incomplete tail
+    group — and it is what keeps partial files coherent with the
+    head-of-file byte layout (module docstring): a partial group's k
+    blocks are the first k of its group, stored at/loaded from the head
+    of that group's file.  A tail-only store (resuming mid-group) cannot
+    be expressed; re-store the whole group — size-aware dedupe makes the
+    full rewrite upgrade the partial file.
     """
     if not file_hashes:
         return []
@@ -71,8 +88,9 @@ def group_blocks_per_file(
         )
     groups: List[FileBlockGroup] = []
     cursor = 0
+    last = len(file_hashes) - 1
     for i, file_hash in enumerate(file_hashes):
-        take = remainder if i == 0 else blocks_per_file
+        take = remainder if i == last else blocks_per_file
         groups.append((file_hash, list(block_ids[cursor : cursor + take])))
         cursor += take
     return groups
@@ -131,7 +149,9 @@ class DeviceToStorageHandler(_HandlerBase):
         for file_hash, ids in groups:
             paths.append(self.file_mapper.get_file_name(file_hash))
             chunk = host[:, cursor : cursor + len(ids)]
-            buffers.append(np.ascontiguousarray(chunk))
+            # Layer-major gather -> block-major file bytes (see module
+            # docstring: head-of-file == first blocks).
+            buffers.append(np.ascontiguousarray(np.moveaxis(chunk, 1, 0)))
             cursor += len(ids)
         self._job_hashes[job_id] = [h for h, _ in groups]
         self.engine.store(job_id, paths, buffers, skip_existing=True)
@@ -167,11 +187,13 @@ class StorageToDeviceHandler(_HandlerBase):
         all_ids: List[int] = []
         for file_hash, ids in groups:
             paths.append(self.file_mapper.get_file_name(file_hash))
+            # Block-major to match the file bytes; transposed back to the
+            # pool's layer-major layout at scatter time.
             buffers.append(
                 np.empty(
                     (
-                        c.num_layers,
                         len(ids),
+                        c.num_layers,
                         2,
                         c.block_size,
                         c.num_kv_heads,
@@ -192,6 +214,6 @@ class StorageToDeviceHandler(_HandlerBase):
         if pending is None or status != JobStatus.SUCCEEDED:
             return status
         block_ids, buffers = pending
-        host = np.concatenate(buffers, axis=1)
+        host = np.concatenate([np.moveaxis(b, 0, 1) for b in buffers], axis=1)
         self.pool.scatter_from_host(block_ids, host)
         return status

@@ -161,6 +161,41 @@ class TestOffloadEngine:
         assert engine.wait(51) == JobStatus.SUCCEEDED
         assert open(path, "rb").read() == second.tobytes()
 
+    def test_partial_head_load_from_larger_file(self, engine, tmp_path):
+        """A partial group reads the head of a full group file."""
+        path = tmp_path / "group.bin"
+        full = np.arange(64, dtype=np.uint8)
+        path.write_bytes(full.tobytes())
+        head = np.zeros(32, dtype=np.uint8)
+        engine.load(80, [str(path)], [head])
+        assert engine.wait(80) == JobStatus.SUCCEEDED
+        np.testing.assert_array_equal(head, full[:32])
+
+    def test_partial_store_upgraded_by_full_store(self, engine, tmp_path):
+        """skip_existing skips only files covering >= our bytes: a
+        partial (head) file is upgraded, never the other way."""
+        path = str(tmp_path / "upgrade.bin")
+        partial = np.full(16, 1, dtype=np.uint8)
+        full = np.full(32, 2, dtype=np.uint8)
+        engine.store(81, [path], [partial], skip_existing=True)
+        assert engine.wait(81) == JobStatus.SUCCEEDED
+        engine.store(82, [path], [full], skip_existing=True)
+        assert engine.wait(82) == JobStatus.SUCCEEDED
+        assert open(path, "rb").read() == full.tobytes()
+        # The reverse: a partial store against a full file is a skip.
+        engine.store(83, [path], [partial], skip_existing=True)
+        assert engine.wait(83) == JobStatus.SUCCEEDED
+        assert open(path, "rb").read() == full.tobytes()
+
+    def test_closed_engine_raises(self, engine, tmp_path):
+        engine.close()
+        data = np.zeros(8, dtype=np.uint8)
+        with pytest.raises(RuntimeError, match="closed"):
+            engine.store(90, [str(tmp_path / "x.bin")], [data])
+        with pytest.raises(RuntimeError, match="closed"):
+            engine.get_finished()
+        engine.close()  # idempotent
+
     def test_wait_unknown_job(self, engine):
         assert engine.wait(999) == JobStatus.UNKNOWN
 

@@ -81,9 +81,10 @@ class TestGrouping:
         groups = group_blocks_per_file([1, 2], [10, 11, 12, 13], 2)
         assert groups == [(1, [10, 11]), (2, [12, 13])]
 
-    def test_partial_first_group(self):
+    def test_partial_last_group(self):
+        """Prefix semantics: the tail group carries the remainder."""
         groups = group_blocks_per_file([1, 2], [11, 12, 13], 2)
-        assert groups == [(1, [11]), (2, [12, 13])]
+        assert groups == [(1, [11, 12]), (2, [13])]
 
     def test_invalid_split_raises(self):
         with pytest.raises(ValueError):
@@ -183,6 +184,58 @@ class TestStoreLoadRoundtrip:
         assert connector.load_handler.wait(20) == JobStatus.FAILED
         connector.close()
 
+    def test_partial_store_then_full_load(self, tmp_path):
+        """A partial tail group stores a head-sized file; the manager
+        promises only full groups; a later full store upgrades the
+        partial file; partial head loads read coherent bytes."""
+        connector, pool = make_connector(tmp_path)
+        manager = connector.get_manager()
+        fill_pool_blocks(pool, [0, 1, 2])
+
+        # Tail group partial: 0xA full [0,1]; 0xB carries 1 of 2 blocks.
+        connector.store_handler.transfer_async(
+            30, group_blocks_per_file([0xA, 0xB], [0, 1, 2], 2)
+        )
+        assert connector.store_handler.wait(30) == JobStatus.SUCCEEDED
+        # Size-aware lookup: 0xA full counts; partial 0xB stops the scan.
+        assert manager.lookup([0xA, 0xB]) == 1
+
+        # Partial head load of 0xB's resident block works.
+        connector.load_handler.transfer_async(
+            31, group_blocks_per_file([0xB], [10], 2)
+        )
+        assert connector.load_handler.wait(31) == JobStatus.SUCCEEDED
+        np.testing.assert_array_equal(
+            pool.gather_to_host([10]), pool.gather_to_host([2])
+        )
+
+        # Full store upgrades the partial file; lookup now promises both.
+        connector.store_handler.transfer_async(
+            32, group_blocks_per_file([0xA, 0xB], [0, 1, 2, 1], 2)
+        )
+        assert connector.store_handler.wait(32) == JobStatus.SUCCEEDED
+        assert manager.lookup([0xA, 0xB]) == 2
+        connector.close()
+
+    def test_pool_external_reference_survives_load(self, tmp_path):
+        """The serving loop holds pool.kv across steps; an async load
+        completion must not delete that buffer out from under it."""
+        connector, pool = make_connector(tmp_path)
+        fill_pool_blocks(pool, [0, 1])
+        connector.store_handler.transfer_async(
+            40, group_blocks_per_file([0xE], [0, 1], 2)
+        )
+        assert connector.store_handler.wait(40) == JobStatus.SUCCEEDED
+
+        held = pool.kv  # external reference, as prefill/decode take
+        connector.load_handler.transfer_async(
+            41, group_blocks_per_file([0xE], [4, 5], 2)
+        )
+        assert connector.load_handler.wait(41) == JobStatus.SUCCEEDED
+        # Old buffer still readable (no donation on the async path).
+        np.asarray(held)
+        connector.close()
+
 
 class TestManager:
     def test_lookup_consecutive(self, tmp_path):
@@ -202,6 +255,22 @@ class TestManager:
         output = manager.prepare_store([0x5, 0x6])
         assert output.block_hashes_to_store == [0x5, 0x6]
         assert output.block_hashes_evicted == []
+        connector.close()
+
+    def test_touch_refreshes_mtime(self, tmp_path):
+        connector, pool = make_connector(tmp_path)
+        manager = connector.get_manager()
+        fill_pool_blocks(pool, [0, 1])
+        connector.store_handler.transfer_async(
+            1, group_blocks_per_file([0x9], [0, 1], 2)
+        )
+        assert connector.store_handler.wait(1) == JobStatus.SUCCEEDED
+        path = connector.file_mapper.get_file_name(0x9)
+        old = time.time() - 3600
+        os.utime(path, (old, old))
+        manager.touch([0x9])
+        assert os.path.getmtime(path) > old + 1800
+        manager.touch([0xDEAD])  # missing file: best-effort no-raise
         connector.close()
 
 
