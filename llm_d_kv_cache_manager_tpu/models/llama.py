@@ -31,6 +31,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from llm_d_kv_cache_manager_tpu.ops.attention import causal_gqa_attention
+from llm_d_kv_cache_manager_tpu.ops.flash_attention import flash_gqa_attention
 from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
 
 Params = Dict[str, Any]
@@ -47,6 +48,11 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     block_size: int = 16  # paged-KV block, matches the index block size
     dtype: str = "bfloat16"
+    # Key-axis length at/above which prefill attention switches from the
+    # dense path to blockwise flash attention (O(tile) memory; the
+    # long-context prefill path).  Static shapes make this a trace-time
+    # choice.
+    flash_attention_min_len: int = 1024
 
     @property
     def head_dim(self) -> int:
@@ -150,6 +156,25 @@ def _qkv(x: jnp.ndarray, lp: Params, positions: jnp.ndarray, theta: float):
     return _rope(q, positions, theta), _rope(k, positions, theta), v
 
 
+def _logits(x: jnp.ndarray, params: Params) -> jnp.ndarray:
+    """Shared epilogue: final norm + tied-embedding head, f32 logits for
+    a stable softmax/loss."""
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum(
+        "...d,vd->...v",
+        x.astype(jnp.float32),
+        params["embed"].astype(jnp.float32),
+    )
+
+
+def _prefill_attention(q, k, v, cfg: LlamaConfig, q_offset=0):
+    """Dense for short sequences, blockwise flash for long (static
+    shapes make the switch a trace-time decision)."""
+    if k.shape[1] >= cfg.flash_attention_min_len:
+        return flash_gqa_attention(q, k, v, q_offset=q_offset)
+    return causal_gqa_attention(q, k, v, q_offset=q_offset)
+
+
 def forward(
     params: Params,
     tokens: jnp.ndarray,
@@ -165,17 +190,13 @@ def forward(
     def layer(x, lp):
         h = _rms_norm(x, lp["ln1"])
         q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
-        attn = causal_gqa_attention(q, k, v)
+        attn = _prefill_attention(q, k, v, cfg)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
         return x, None
 
     x, _ = lax.scan(layer, x, params["layers"])
-    x = _rms_norm(x, params["ln_f"])
-    # Tied embedding head; f32 logits for a stable softmax/loss.
-    return jnp.einsum(
-        "btd,vd->btv", x.astype(jnp.float32), params["embed"].astype(jnp.float32)
-    )
+    return _logits(x, params)
 
 
 def prefill_paged(
@@ -202,7 +223,7 @@ def prefill_paged(
         lp, kv_layer = inputs
         h = _rms_norm(x, lp["ln1"])
         q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
-        attn = causal_gqa_attention(q, k, v)
+        attn = _prefill_attention(q, k, v, cfg)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
         # [B, T, Hkv, Dh] -> [B, nb, block, Hkv, Dh] -> pool scatter
@@ -215,11 +236,72 @@ def prefill_paged(
         return x, kv_layer
 
     x, kv_pool = lax.scan(layer, x, (params["layers"], kv_pool))
-    x = _rms_norm(x, params["ln_f"])
-    logits = jnp.einsum(
-        "btd,vd->btv", x.astype(jnp.float32), params["embed"].astype(jnp.float32)
+    return _logits(x, params), kv_pool
+
+
+def prefill_continue(
+    params: Params,
+    tokens: jnp.ndarray,
+    kv_pool: jnp.ndarray,
+    block_table: jnp.ndarray,
+    prefix_len: int,
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Prefill only the uncached suffix of a prompt (prefix-cache hit).
+
+    The first ``prefix_len`` tokens' K/V already live in the pool (a
+    prior request stored them, or the offload connector loaded them);
+    this computes the suffix in one dense pass attending over
+    gathered-prefix + new K/V, and scatters the suffix blocks back.
+    This is what turns an index hit into real TTFT savings — the
+    compute analogue of vLLM's prefix-cache hit that the reference
+    routes toward (SURVEY.md §6 north star).
+
+    tokens: [B, Ts] suffix tokens, Ts % block_size == 0.
+    block_table: [B, (prefix_len + Ts) / block_size] — prefix blocks
+    first, then the blocks to write.  ``prefix_len`` is static
+    (% block_size == 0); one compile per distinct padded prefix length.
+    Returns (suffix logits [B, Ts, V], new kv_pool).
+    """
+    B, Ts = tokens.shape
+    if prefix_len % cfg.block_size or Ts % cfg.block_size:
+        raise ValueError("prefix_len and Ts must be block_size multiples")
+    npre = prefix_len // cfg.block_size
+    nsuf = Ts // cfg.block_size
+    positions = jnp.broadcast_to(
+        prefix_len + jnp.arange(Ts), (B, Ts)
     )
-    return logits, kv_pool
+    x = jnp.take(params["embed"], tokens, axis=0)
+    prefix_ids = block_table[:, :npre]  # [B, npre]
+    suffix_ids = block_table[:, npre : npre + nsuf]
+
+    def layer(x, inputs):
+        lp, kv_layer = inputs
+        h = _rms_norm(x, lp["ln1"])
+        q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
+        # Gather the prefix K/V: [B, npre, 2, block, Hkv, Dh].
+        pre = jnp.take(kv_layer, prefix_ids, axis=0)
+        pre = pre.transpose(0, 2, 1, 3, 4, 5).reshape(
+            B, 2, prefix_len, k.shape[-2], k.shape[-1]
+        )
+        k_full = jnp.concatenate(
+            (pre[:, 0].astype(k.dtype), k), axis=1
+        )  # [B, prefix+Ts, Hkv, Dh]
+        v_full = jnp.concatenate((pre[:, 1].astype(v.dtype), v), axis=1)
+        attn = _prefill_attention(q, k_full, v_full, cfg, q_offset=prefix_len)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+        x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
+        kv = jnp.stack((k, v), axis=2)  # [B, Ts, 2, Hkv, Dh]
+        kv = kv.reshape(
+            B, nsuf, cfg.block_size, 2, kv.shape[-2], kv.shape[-1]
+        ).transpose(0, 1, 3, 2, 4, 5)
+        kv_layer = kv_layer.at[suffix_ids.reshape(-1)].set(
+            kv.reshape((-1,) + kv.shape[2:]).astype(kv_layer.dtype)
+        )
+        return x, kv_layer
+
+    x, kv_pool = lax.scan(layer, x, (params["layers"], kv_pool))
+    return _logits(x, params), kv_pool
 
 
 def decode_step(
@@ -262,11 +344,7 @@ def decode_step(
         return x, kv_layer
 
     x, kv_pool = lax.scan(layer, x, (params["layers"], kv_pool))
-    x = _rms_norm(x, params["ln_f"])
-    logits = jnp.einsum(
-        "bd,vd->bv", x.astype(jnp.float32), params["embed"].astype(jnp.float32)
-    )
-    return logits, kv_pool
+    return _logits(x, params), kv_pool
 
 
 # ---------------------------------------------------------------- training

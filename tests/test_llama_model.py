@@ -57,6 +57,41 @@ def test_paged_prefill_matches_dense(params):
     assert float(jnp.abs(kv_pool).sum()) > 0  # blocks were written
 
 
+def test_prefill_continue_matches_dense(params):
+    """Prefill a prefix, then continue with the suffix from the cached
+    pool; suffix logits must match one dense pass over the whole
+    prompt, and the suffix blocks must land in the pool."""
+    B, T, P = 2, 12, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (B, T), 0, 128)
+    nb = T // CFG.block_size + 1  # one spare block for the decode step
+    kv_pool = jnp.zeros(
+        (CFG.n_layers, 16, 2, CFG.block_size, CFG.n_kv_heads, CFG.head_dim),
+        jnp.float32,
+    )
+    table = jnp.arange(B * nb, dtype=jnp.int32).reshape(B, nb)
+    _, kv_pool = llama.prefill_paged(
+        params, tokens[:, :P], kv_pool, table[:, : P // CFG.block_size], CFG
+    )
+    cont_logits, kv_pool = llama.prefill_continue(
+        params, tokens[:, P:], kv_pool, table, P, CFG
+    )
+    dense_logits = llama.forward(params, tokens, CFG)[:, P:]
+    np.testing.assert_allclose(
+        np.asarray(cont_logits), np.asarray(dense_logits), rtol=2e-4, atol=2e-4
+    )
+    # Decode on top of the continued pool agrees with dense too.
+    next_tok = jnp.argmax(cont_logits[:, -1], -1)
+    ctx = jnp.full((B,), T + 1, jnp.int32)
+    dec_logits, _ = llama.decode_step(
+        params, next_tok, kv_pool, table, ctx, CFG
+    )
+    seq = jnp.concatenate([tokens, next_tok[:, None]], axis=1)
+    dense_last = llama.forward(params, seq, CFG)[:, -1]
+    np.testing.assert_allclose(
+        np.asarray(dec_logits), np.asarray(dense_last), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_paged_decode_matches_dense(params):
     """Prefill a prompt, decode a few tokens, check each decode logit
     equals the dense forward over the growing sequence."""
