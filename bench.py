@@ -305,7 +305,11 @@ def run_fleet(
             int(jnp.argmax(logits[0, -1]))
             ttfts.append(time.perf_counter() - t0)
 
-            for h, bid in zip(hashes, block_ids):
+            # Register only newly-written blocks: re-registering the hit
+            # prefix would resurrect hashes that alloc() just evicted when
+            # the allocator wrapped into the cached prefix region, mapping
+            # them to blocks that now hold suffix KV.
+            for h, bid in zip(hashes[first_new:], block_ids[first_new:]):
                 pod.cached[h] = bid
                 pod._block_owner[bid] = h
             publish_events(

@@ -1,0 +1,12 @@
+"""gRPC API layer (reference: api/indexerpb, api/tokenizerpb).
+
+Message classes are protoc-generated (``*_pb2.py``, checked in — the
+image ships ``protoc`` but not ``grpc_tools``); the service stubs and
+servicer registration in ``grpc_services.py`` are hand-written over
+grpcio's generic-handler API, which produces the same wire behavior as
+plugin-generated code.
+"""
+
+from llm_d_kv_cache_manager_tpu.api import indexer_pb2, tokenizer_pb2
+
+__all__ = ["indexer_pb2", "tokenizer_pb2"]

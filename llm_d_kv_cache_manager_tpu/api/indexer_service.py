@@ -1,0 +1,71 @@
+"""gRPC scoring service wrapping the Indexer.
+
+TPU-native counterpart of the reference's index service
+(examples/kv_cache_index_service/server/server.go:67-93): one RPC,
+``GetPodScores``, delegating to ``Indexer.get_pod_scores``.  Serves TCP
+or Unix-domain endpoints (``unix:///path.sock``).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from llm_d_kv_cache_manager_tpu.api import indexer_pb2
+from llm_d_kv_cache_manager_tpu.api.grpc_services import (
+    IndexerServiceServicer,
+    IndexerServiceStub,
+    add_indexer_servicer,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("api.indexer_service")
+
+
+class IndexerGrpcService(IndexerServiceServicer):
+    def __init__(self, indexer: Indexer) -> None:
+        self.indexer = indexer
+
+    def GetPodScores(self, request, context):
+        try:
+            scores = self.indexer.get_pod_scores(
+                prompt=request.prompt,
+                model_name=request.model_name,
+                pod_identifiers=list(request.pod_identifiers) or None,
+            )
+        except Exception as exc:
+            logger.exception("GetPodScores failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(exc))
+            return indexer_pb2.GetPodScoresResponse()
+        response = indexer_pb2.GetPodScoresResponse()
+        # Deterministic order: score desc, pod asc (stable for clients).
+        for pod, score in sorted(
+            scores.items(), key=lambda kv: (-kv[1], kv[0])
+        ):
+            response.scores.add(pod=pod, score=score)
+        return response
+
+
+def serve(
+    indexer: Indexer,
+    address: str = "[::]:50051",
+    max_workers: int = 8,
+    server: Optional[grpc.Server] = None,
+) -> grpc.Server:
+    """Build+start a server; returns it (caller owns lifetime)."""
+    if server is None:
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+    add_indexer_servicer(IndexerGrpcService(indexer), server)
+    server.add_insecure_port(address)
+    server.start()
+    logger.info("indexer gRPC service listening on %s", address)
+    return server
+
+
+def new_client(address: str) -> IndexerServiceStub:
+    return IndexerServiceStub(grpc.insecure_channel(address))

@@ -71,6 +71,10 @@ class IndexerConfig:
     )
     # Directory searched by the local tokenizer backend; None disables it.
     local_tokenizers_dir: Optional[str] = None
+    # UDS path of a tokenizer sidecar (services/uds_tokenizer); None
+    # disables that backend.  Composite order mirrors the reference's
+    # local -> uds -> hf fallback chain (pkg/tokenization/pool.go:97-145).
+    uds_tokenizer_path: Optional[str] = None
 
 
 class Indexer:
@@ -102,6 +106,12 @@ class Indexer:
                 backends.append(
                     LocalFastTokenizer(self.config.local_tokenizers_dir)
                 )
+            if self.config.uds_tokenizer_path:
+                from llm_d_kv_cache_manager_tpu.tokenization.uds_tokenizer import (  # noqa: E501 - lazy: grpc only when configured
+                    UdsTokenizer,
+                )
+
+                backends.append(UdsTokenizer(self.config.uds_tokenizer_path))
             backends.append(TransformersTokenizer())
             tokenizer = CompositeTokenizer(backends)
         self.tokenization_pool = TokenizationPool(

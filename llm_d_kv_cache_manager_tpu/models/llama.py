@@ -167,10 +167,16 @@ def _logits(x: jnp.ndarray, params: Params) -> jnp.ndarray:
     )
 
 
-def _prefill_attention(q, k, v, cfg: LlamaConfig, q_offset=0):
+def _prefill_attention(q, k, v, cfg: LlamaConfig, q_offset=0, use_flash=True):
     """Dense for short sequences, blockwise flash for long (static
-    shapes make the switch a trace-time decision)."""
-    if k.shape[1] >= cfg.flash_attention_min_len:
+    shapes make the switch a trace-time decision).
+
+    ``use_flash=False`` forces dense: the scan-based flash op has no
+    custom VJP, so under ``grad`` it keeps the same O(Tq*Tk) residuals
+    as dense while serializing the backward chunk-by-chunk — training
+    paths should differentiate through the fused dense einsum instead.
+    """
+    if use_flash and k.shape[1] >= cfg.flash_attention_min_len:
         return flash_gqa_attention(q, k, v, q_offset=q_offset)
     return causal_gqa_attention(q, k, v, q_offset=q_offset)
 
@@ -180,6 +186,7 @@ def forward(
     tokens: jnp.ndarray,
     cfg: LlamaConfig,
     positions: Optional[jnp.ndarray] = None,
+    use_flash: bool = True,
 ) -> jnp.ndarray:
     """Dense forward: tokens [B, T] -> logits [B, T, V]."""
     B, T = tokens.shape
@@ -190,7 +197,7 @@ def forward(
     def layer(x, lp):
         h = _rms_norm(x, lp["ln1"])
         q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
-        attn = _prefill_attention(q, k, v, cfg)
+        attn = _prefill_attention(q, k, v, cfg, use_flash=use_flash)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
         return x, None
@@ -354,7 +361,7 @@ def loss_fn(
     params: Params, tokens: jnp.ndarray, cfg: LlamaConfig
 ) -> jnp.ndarray:
     """Next-token cross entropy over tokens [B, T]."""
-    logits = forward(params, tokens[:, :-1], cfg)
+    logits = forward(params, tokens[:, :-1], cfg, use_flash=False)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

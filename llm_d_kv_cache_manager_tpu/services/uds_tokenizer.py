@@ -1,0 +1,177 @@
+"""Tokenizer sidecar: TokenizationService over a Unix-domain socket.
+
+Counterpart of the reference's Python sidecar
+(services/uds_tokenizer/tokenizer_grpc_service.py:32-160): per-model
+cached HF tokenizers, ``Tokenize`` with offset mapping, chat-template
+rendering, and an init RPC that pre-warms a model.  The reference runs
+this to give its Go indexer tokenizer access across a process boundary;
+here it exists for the same fleet topology (a shared tokenizer sidecar
+serving many indexer replicas) and for reference-client compat — the
+in-process backends (tokenization/tokenizers.py) remain the default.
+
+Message caps mirror the reference client's 100 MB limits
+(pkg/tokenization/uds_tokenizer.go:64-77).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent import futures
+from typing import Dict, Optional
+
+import grpc
+
+from llm_d_kv_cache_manager_tpu.api import tokenizer_pb2
+from llm_d_kv_cache_manager_tpu.api.grpc_services import (
+    TokenizationServiceServicer,
+    add_tokenization_servicer,
+    struct_map_to_dict,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+    load_auto_tokenizer,
+)
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("services.uds_tokenizer")
+
+MAX_MESSAGE_BYTES = 100 * 1024 * 1024
+
+
+class TokenizerRegistry:
+    """Thread-safe per-model tokenizer cache (reference:
+    tokenizer_service/tokenizer.py:104-140)."""
+
+    def __init__(self) -> None:
+        self._tokenizers: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, model_name: str, tokenizer) -> None:
+        """Inject a pre-built tokenizer (tests, local models)."""
+        with self._lock:
+            self._tokenizers[model_name] = tokenizer
+
+    def get(self, model_name: str):
+        with self._lock:
+            tokenizer = self._tokenizers.get(model_name)
+        if tokenizer is None:
+            tokenizer = load_auto_tokenizer(model_name)
+            with self._lock:
+                self._tokenizers[model_name] = tokenizer
+        return tokenizer
+
+
+class TokenizationGrpcService(TokenizationServiceServicer):
+    def __init__(self, registry: Optional[TokenizerRegistry] = None) -> None:
+        self.registry = registry or TokenizerRegistry()
+
+    def Tokenize(self, request, context):
+        response = tokenizer_pb2.TokenizeResponse()
+        try:
+            tokenizer = self.registry.get(request.model_name)
+            output = tokenizer(
+                request.input,
+                add_special_tokens=request.add_special_tokens,
+                return_offsets_mapping=True,
+            )
+            response.input_ids.extend(output["input_ids"])
+            for start, end in output["offset_mapping"]:
+                response.offset_pairs.extend((start, end))
+            response.success = True
+        except Exception as exc:
+            logger.exception("Tokenize failed for %s", request.model_name)
+            response.success = False
+            response.error_message = str(exc)
+        return response
+
+    def RenderChatTemplate(self, request, context):
+        response = tokenizer_pb2.ChatTemplateResponse()
+        try:
+            tokenizer = self.registry.get(request.model_name)
+            # Turns are a wire-batching artifact; the template sees one
+            # flat message list (HF batch mode would otherwise return a
+            # list of strings for multi-turn requests).
+            conversation = [
+                {"role": m.role, "content": m.content}
+                for turn in request.conversation_turns
+                for m in turn.messages
+            ]
+            tools = [
+                struct_map_to_dict(tool.tool) for tool in request.tools
+            ] or None
+            documents = [
+                struct_map_to_dict(doc.document) for doc in request.documents
+            ] or None
+            kwargs = struct_map_to_dict(request.chat_template_kwargs)
+            rendered = tokenizer.apply_chat_template(
+                conversation,
+                tools=tools,
+                documents=documents,
+                chat_template=request.chat_template or None,
+                add_generation_prompt=request.add_generation_prompt,
+                continue_final_message=request.continue_final_message,
+                tokenize=False,
+                **kwargs,
+            )
+            response.rendered_prompt = rendered
+            response.success = True
+        except Exception as exc:
+            logger.exception(
+                "RenderChatTemplate failed for %s", request.model_name
+            )
+            response.success = False
+            response.error_message = str(exc)
+        return response
+
+    def InitializeTokenizer(self, request, context):
+        response = tokenizer_pb2.InitializeTokenizerResponse()
+        try:
+            self.registry.get(request.model_name)
+            response.success = True
+        except Exception as exc:
+            logger.exception(
+                "InitializeTokenizer failed for %s", request.model_name
+            )
+            response.success = False
+            response.error_message = str(exc)
+        return response
+
+
+def serve(
+    uds_path: str = "/tmp/kvcache_tokenizer.sock",
+    max_workers: Optional[int] = None,
+    registry: Optional[TokenizerRegistry] = None,
+) -> grpc.Server:
+    """Start the sidecar on a UDS endpoint; returns the server."""
+    if os.path.exists(uds_path):
+        os.unlink(uds_path)
+    server = grpc.server(
+        futures.ThreadPoolExecutor(
+            max_workers=max_workers or os.cpu_count() or 4
+        ),
+        options=[
+            ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+            ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+        ],
+    )
+    add_tokenization_servicer(TokenizationGrpcService(registry), server)
+    server.add_insecure_port(f"unix://{uds_path}")
+    server.start()
+    logger.info("uds tokenizer service listening on %s", uds_path)
+    return server
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import signal
+
+    uds_path = os.environ.get("UDS_PATH", "/tmp/kvcache_tokenizer.sock")
+    server = serve(uds_path)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    server.stop(grace=5)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,235 @@
+"""gRPC API layer: indexer scoring service + tokenizer sidecar.
+
+Covers the reference's api/ surface (indexer.proto, tokenizer.proto)
+end-to-end over real grpcio channels on Unix-domain sockets: score
+round-trips, sidecar tokenize/render/init, the UDS client backend, and
+the Value kwargs codec.
+"""
+
+import os
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.api import indexer_pb2, tokenizer_pb2
+from llm_d_kv_cache_manager_tpu.api.grpc_services import (
+    python_to_value,
+    value_to_python,
+)
+from llm_d_kv_cache_manager_tpu.api.indexer_service import new_client, serve
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.services.uds_tokenizer import (
+    TokenizerRegistry,
+)
+from llm_d_kv_cache_manager_tpu.services.uds_tokenizer import (
+    serve as serve_tokenizer,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+    LocalFastTokenizer,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.uds_tokenizer import UdsTokenizer
+from tests.helpers.tiny_tokenizer import (
+    build_transformers_tokenizer,
+    save_tokenizer_json,
+)
+
+MODEL = "test-model"
+BLOCK_SIZE = 4
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+@pytest.fixture()
+def indexer(tmp_path):
+    tokenizer_dir = save_tokenizer_json(str(tmp_path), MODEL)
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE
+            ),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                workers=2, model_name=MODEL
+            ),
+        ),
+        tokenizer=LocalFastTokenizer(tokenizer_dir),
+    )
+    indexer.run()
+    yield indexer
+    indexer.shutdown()
+
+
+def seed_index(indexer, prompt, pod):
+    """Store the prompt's block chain for a pod, bypassing events."""
+    tokens = indexer.tokenization_pool.tokenize(prompt, MODEL, None)
+    keys = indexer.token_processor.tokens_to_kv_block_keys(
+        EMPTY_BLOCK_HASH, tokens, MODEL
+    )
+    indexer.kv_block_index.add(keys, keys, [PodEntry(pod, "hbm")])
+    return keys
+
+
+@pytest.fixture()
+def scoring_endpoint(indexer, tmp_path):
+    uds = os.path.join(str(tmp_path), "indexer.sock")
+    server = serve(indexer, f"unix://{uds}")
+    yield indexer, f"unix://{uds}"
+    server.stop(grace=None)
+
+
+class TestIndexerService:
+    def test_score_round_trip(self, scoring_endpoint):
+        indexer, address = scoring_endpoint
+        seed_index(indexer, PROMPT, "pod-a")
+        client = new_client(address)
+        response = client.GetPodScores(
+            indexer_pb2.GetPodScoresRequest(
+                prompt=PROMPT,
+                model_name=MODEL,
+                pod_identifiers=["pod-a", "pod-b"],
+            )
+        )
+        scores = {s.pod: s.score for s in response.scores}
+        assert scores["pod-a"] > 0
+        assert "pod-b" not in scores or scores["pod-b"] == 0
+
+    def test_empty_index_scores_nothing(self, scoring_endpoint):
+        _, address = scoring_endpoint
+        client = new_client(address)
+        response = client.GetPodScores(
+            indexer_pb2.GetPodScoresRequest(
+                prompt=PROMPT, model_name=MODEL
+            )
+        )
+        assert len(response.scores) == 0
+
+    def test_scores_sorted_descending(self, scoring_endpoint):
+        indexer, address = scoring_endpoint
+        # pod-a holds the full chain, pod-b only the first block.
+        keys = seed_index(indexer, PROMPT, "pod-a")
+        indexer.kv_block_index.add(
+            keys[:1], keys[:1], [PodEntry("pod-b", "hbm")]
+        )
+        client = new_client(address)
+        response = client.GetPodScores(
+            indexer_pb2.GetPodScoresRequest(
+                prompt=PROMPT, model_name=MODEL
+            )
+        )
+        values = [s.score for s in response.scores]
+        assert values == sorted(values, reverse=True)
+        assert response.scores[0].pod == "pod-a"
+
+
+@pytest.fixture()
+def tokenizer_sidecar(tmp_path):
+    registry = TokenizerRegistry()
+    registry.register(MODEL, build_transformers_tokenizer())
+    uds = os.path.join(str(tmp_path), "tokenizer.sock")
+    server = serve_tokenizer(uds, max_workers=2, registry=registry)
+    yield uds
+    server.stop(grace=None)
+
+
+class TestTokenizerSidecar:
+    def test_tokenize_with_offsets(self, tokenizer_sidecar):
+        client = UdsTokenizer(tokenizer_sidecar)
+        encoding = client.encode(PROMPT, MODEL, add_special_tokens=False)
+        assert len(encoding.tokens) == len(PROMPT.split())
+        assert len(encoding.offsets) == len(encoding.tokens)
+        # Offsets index into the prompt at word boundaries.
+        start, end = encoding.offsets[1]
+        assert PROMPT[start:end] == "quick"
+        client.close()
+
+    def test_matches_local_backend(self, tokenizer_sidecar, tmp_path):
+        local_dir = save_tokenizer_json(str(tmp_path / "local"), MODEL)
+        local = LocalFastTokenizer(local_dir)
+        client = UdsTokenizer(tokenizer_sidecar)
+        via_uds = client.encode(PROMPT, MODEL, add_special_tokens=False)
+        via_local = local.encode(PROMPT, MODEL, add_special_tokens=False)
+        assert via_uds.tokens == via_local.tokens
+        assert via_uds.offsets == via_local.offsets
+        client.close()
+
+    def test_initialize_and_render(self, tokenizer_sidecar):
+        client = UdsTokenizer(tokenizer_sidecar)
+        client.initialize_model(MODEL)
+
+        request = tokenizer_pb2.ChatTemplateRequest(
+            model_name=MODEL, add_generation_prompt=True
+        )
+        turn = request.conversation_turns.add()
+        turn.messages.add(role="user", content="hello world")
+        response = client._stub.RenderChatTemplate(request)
+        assert response.success
+        assert "<|user|> hello world" in response.rendered_prompt
+        assert response.rendered_prompt.endswith("<|assistant|>")
+        client.close()
+
+    def test_multi_turn_render(self, tokenizer_sidecar):
+        client = UdsTokenizer(tokenizer_sidecar)
+        request = tokenizer_pb2.ChatTemplateRequest(
+            model_name=MODEL, add_generation_prompt=True
+        )
+        for role, content in (
+            ("user", "hello"),
+            ("assistant", "world"),
+            ("user", "again"),
+        ):
+            turn = request.conversation_turns.add()
+            turn.messages.add(role=role, content=content)
+        response = client._stub.RenderChatTemplate(request)
+        assert response.success, response.error_message
+        assert "<|user|> hello" in response.rendered_prompt
+        assert "<|assistant|> world" in response.rendered_prompt
+        assert response.rendered_prompt.endswith("<|assistant|>")
+        client.close()
+
+    def test_unknown_model_reports_error(self, tokenizer_sidecar):
+        client = UdsTokenizer(tokenizer_sidecar)
+        request = tokenizer_pb2.TokenizeRequest(
+            input="x", model_name="no/such-model-xyz"
+        )
+        response = client._stub.Tokenize(request)
+        assert not response.success
+        assert response.error_message
+        client.close()
+
+
+class TestValueCodec:
+    def test_round_trip(self):
+        payload = {
+            "name": "tool",
+            "depth": 3,
+            "ratio": 0.5,
+            "flag": True,
+            "items": ["a", 1, False],
+            "nested": {"k": "v"},
+        }
+        assert value_to_python(python_to_value(payload)) == payload
+
+    def test_integral_floats_decode_as_int(self):
+        value = tokenizer_pb2.Value(number_value=7.0)
+        assert value_to_python(value) == 7
+        assert isinstance(value_to_python(value), int)
+
+
+class TestUdsInIndexerConfig:
+    def test_composite_includes_uds_backend(self, tokenizer_sidecar):
+        indexer = Indexer(
+            IndexerConfig(
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    workers=1, model_name=MODEL
+                ),
+                uds_tokenizer_path=tokenizer_sidecar,
+            )
+        )
+        names = indexer.tokenization_pool._tokenizer.type()
+        assert "uds" in names
+        indexer.shutdown()
