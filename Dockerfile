@@ -1,0 +1,27 @@
+# Indexer / scoring-service image (reference: Dockerfile).
+#
+# The indexer is control-plane only — it needs no TPU; vLLM-TPU pods run
+# their own image with the offload connector installed. CPU jax keeps
+# the image small while sharing the exact hashing/indexing code paths.
+FROM python:3.12-slim AS base
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ libzmq3-dev && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+RUN pip install --no-cache-dir \
+        "jax[cpu]" numpy msgpack pyzmq grpcio protobuf \
+        prometheus-client transformers tokenizers
+
+COPY llm_d_kv_cache_manager_tpu ./llm_d_kv_cache_manager_tpu
+# Build the native engine (hash fast path + offload I/O pool) in-tree.
+RUN python -m llm_d_kv_cache_manager_tpu.native.build
+
+EXPOSE 8080 5557
+ENV PYTHONUNBUFFERED=1
+# PYTHONHASHSEED must match the serving fleet's seed or block hashes
+# diverge fleet-wide (SURVEY §5 config invariant).
+ENV PYTHONHASHSEED=42
+
+ENTRYPOINT ["python", "-m", "llm_d_kv_cache_manager_tpu.api.http_service"]

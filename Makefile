@@ -1,0 +1,43 @@
+# Developer entry points (reference: Makefile targets unit-test /
+# e2e-test / bench, .github/workflows/ci-pr-checks.yaml).
+
+PYTHON ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: all test unit-test e2e-test examples bench native proto graft-check clean
+
+all: native test
+
+test: unit-test
+
+unit-test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+e2e-test:
+	$(PYTHON) -m pytest tests/test_indexer_e2e.py tests/test_zmq_integration.py tests/test_grpc_api.py tests/test_http_service.py -q
+
+examples:
+	bash hack/verify-examples.sh
+
+# Fleet-routing benchmark; on TPU hardware drop JAX_PLATFORMS.
+bench:
+	$(PYTHON) bench.py
+
+# Build the native C++ engine in-tree.
+native:
+	$(PYTHON) -m llm_d_kv_cache_manager_tpu.native.build
+
+# Regenerate protobuf message code (grpc wiring is hand-written,
+# api/grpc_services.py).
+proto:
+	cd llm_d_kv_cache_manager_tpu/api && \
+	protoc -I protos --python_out=. protos/indexer.proto protos/tokenizer.proto
+
+# What the driver runs: single-chip compile check + virtual multi-chip.
+graft-check:
+	$(PYTHON) -c "import __graft_entry__ as g; fn, args = g.entry(); import jax; jax.jit(fn)(*args); print('entry ok')"
+	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip ok')"
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true
+	rm -rf .pytest_cache llm_d_kv_cache_manager_tpu/native/_build

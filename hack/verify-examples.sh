@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Runs every example to completion (reference: hack/verify-examples.sh).
+# Each demo asserts its own invariants and prints "... completed
+# successfully"; any failure exits non-zero.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+for demo in offline_demo index_service_demo online_demo; do
+  echo "=== examples/${demo}.py ==="
+  python "examples/${demo}.py" 2>&1 | grep "completed successfully" \
+    || { echo "FAIL: ${demo}"; exit 1; }
+done
+echo "all examples verified"

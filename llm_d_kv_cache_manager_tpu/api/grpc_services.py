@@ -20,6 +20,7 @@ class IndexerServiceStub:
     """Client stub for IndexerService (reference: indexer.proto:24-27)."""
 
     def __init__(self, channel: grpc.Channel) -> None:
+        self.channel = channel  # retained so owners can close() it
         self.GetPodScores = channel.unary_unary(
             f"/{INDEXER_SERVICE}/GetPodScores",
             request_serializer=(
@@ -56,6 +57,7 @@ class TokenizationServiceStub:
     """Client stub for TokenizationService (tokenizer.proto:113-123)."""
 
     def __init__(self, channel: grpc.Channel) -> None:
+        self.channel = channel  # retained so owners can close() it
         self.Tokenize = channel.unary_unary(
             f"/{TOKENIZATION_SERVICE}/Tokenize",
             request_serializer=tokenizer_pb2.TokenizeRequest.SerializeToString,
@@ -163,12 +165,13 @@ def python_to_value(obj) -> tokenizer_pb2.Value:
     elif isinstance(obj, (list, tuple)):
         value.list_value.values.extend(python_to_value(item) for item in obj)
     elif isinstance(obj, dict):
+        value.struct_value.SetInParent()
         for key, item in obj.items():
             value.struct_value.fields[str(key)].CopyFrom(
                 python_to_value(item)
             )
     elif obj is None:
-        value.struct_value.SetInParent()
+        pass  # unset oneof round-trips as None in value_to_python
     else:
         raise TypeError(f"cannot encode {type(obj).__name__} as Value")
     return value

@@ -1,0 +1,227 @@
+"""HTTP scoring service: the online-demo surface as a real module.
+
+Counterpart of the reference's online example service
+(examples/kv_events/online/main.go:273-385): ``POST /score_completions``
+and ``POST /score_chat_completions`` against the live indexer,
+``GET /metrics`` (Prometheus exposition), ``GET /healthz``.  Stdlib
+``http.server`` — threaded, no framework dependency.
+
+Run standalone (env-configured like the reference's example):
+
+    PYTHONHASHSEED=42 BLOCK_SIZE=16 ZMQ_ENDPOINT=tcp://*:5557 \
+    MODEL_NAME=meta-llama/Llama-3.1-8B-Instruct \
+    python -m llm_d_kv_cache_manager_tpu.api.http_service
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+from typing import Optional
+
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer
+from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
+    ApplyChatTemplateRequest,
+)
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("api.http_service")
+
+
+def _make_handler(indexer: Indexer):
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # route through our logger
+            logger.debug("http: " + args[0], *args[1:])
+
+        def _reply(self, status: int, body: bytes, content_type: str):
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, status: int, obj) -> None:
+            self._reply(
+                status, json.dumps(obj).encode(), "application/json"
+            )
+
+        def _error(self, status: int, message: str) -> None:
+            self._reply(status, message.encode() + b"\n", "text/plain")
+
+        def _read_json(self) -> Optional[dict]:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length))
+            except (ValueError, json.JSONDecodeError):
+                self._error(400, "invalid JSON body")
+                return None
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._reply(
+                    200,
+                    METRICS.exposition(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif self.path == "/healthz":
+                self._reply_json(200, {"status": "ok"})
+            else:
+                self._error(404, "not found")
+
+        def do_POST(self):
+            if self.path == "/score_completions":
+                self._score_completions()
+            elif self.path == "/score_chat_completions":
+                self._score_chat_completions()
+            else:
+                self._error(404, "not found")
+
+        def _score_completions(self):
+            request = self._read_json()
+            if request is None:
+                return
+            prompt = request.get("prompt", "")
+            if not prompt:
+                self._error(400, "field 'prompt' required")
+                return
+            try:
+                scores = indexer.get_pod_scores(
+                    prompt=prompt,
+                    model_name=request.get("model", ""),
+                    pod_identifiers=request.get("pods"),
+                )
+            except Exception as exc:
+                logger.exception("score_completions failed")
+                self._error(500, f"error: {exc}")
+                return
+            self._reply_json(200, scores)
+
+        def _score_chat_completions(self):
+            request = self._read_json()
+            if request is None:
+                return
+            messages = request.get("messages")
+            if not messages:
+                self._error(400, "field 'messages' required")
+                return
+            model = request.get("model", "")
+            render_req = ApplyChatTemplateRequest(
+                conversation=messages,
+                tools=request.get("tools"),
+                documents=request.get("documents"),
+                chat_template=request.get("chat_template"),
+                add_generation_prompt=request.get(
+                    "add_generation_prompt", True
+                ),
+                continue_final_message=request.get(
+                    "continue_final_message", False
+                ),
+                chat_template_kwargs=request.get("chat_template_kwargs"),
+                model=model,
+            )
+            try:
+                scores = indexer.get_pod_scores(
+                    prompt="",
+                    model_name=model,
+                    pod_identifiers=request.get("pods"),
+                    render_req=render_req,
+                )
+            except Exception as exc:
+                logger.exception("score_chat_completions failed")
+                self._error(500, f"error: {exc}")
+                return
+            self._reply_json(200, scores)
+
+    return Handler
+
+
+def serve(
+    indexer: Indexer, host: str = "0.0.0.0", port: int = 8080
+) -> http.server.ThreadingHTTPServer:
+    """Start the HTTP service on a background thread; returns the server
+    (call ``.shutdown()`` to stop)."""
+    server = http.server.ThreadingHTTPServer(
+        (host, port), _make_handler(indexer)
+    )
+    thread = threading.Thread(
+        target=server.serve_forever, name="http-service", daemon=True
+    )
+    thread.start()
+    logger.info("http scoring service listening on %s:%d", host, port)
+    return server
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    """Env-configured standalone service: indexer + event subscription
+    (the reference's online example, main.go:93-148)."""
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import IndexerConfig
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.pool import Pool, PoolConfig
+    from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
+        SubscriberManager,
+    )
+    from llm_d_kv_cache_manager_tpu.metrics.collector import (
+        start_metrics_logging,
+    )
+    from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+        TokenizationPoolConfig,
+    )
+
+    config = IndexerConfig(
+        token_processor_config=TokenProcessorConfig(
+            block_size=int(os.environ.get("BLOCK_SIZE", "16")),
+            hash_seed=os.environ.get("PYTHONHASHSEED", ""),
+        ),
+        kvblock_index_config=IndexConfig(
+            enable_metrics=os.environ.get("ENABLE_METRICS", "true").lower()
+            != "false"
+        ),
+        tokenizers_pool_config=TokenizationPoolConfig(
+            model_name=os.environ.get("MODEL_NAME", "")
+        ),
+        local_tokenizers_dir=os.environ.get("LOCAL_TOKENIZER_DIR") or None,
+        uds_tokenizer_path=os.environ.get("UDS_TOKENIZER_PATH") or None,
+    )
+    indexer = Indexer(config)
+    indexer.run()
+
+    pool = Pool(
+        indexer.kv_block_index,
+        indexer.token_processor,
+        PoolConfig(
+            concurrency=int(os.environ.get("POOL_CONCURRENCY", "4"))
+        ),
+    )
+    pool.start()
+    manager = SubscriberManager(sink=pool.add_task, bind=True)
+    endpoint = os.environ.get("ZMQ_ENDPOINT", "tcp://*:5557")
+    manager.ensure_subscriber(
+        "global", endpoint, topic_filter=os.environ.get("ZMQ_TOPIC", "kv@")
+    )
+
+    stop_beat = start_metrics_logging(
+        float(os.environ.get("METRICS_LOGGING_INTERVAL", "60"))
+    )
+    server = serve(indexer, port=int(os.environ.get("HTTP_PORT", "8080")))
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop_beat.set()
+        server.shutdown()
+        manager.shutdown()
+        pool.shutdown()
+        indexer.shutdown()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,248 @@
+"""Kubernetes pod discovery driving the subscriber manager.
+
+Counterpart of the reference's controller-runtime reconciler
+(examples/kv_events/pod_reconciler/pod_reconciler.go:86-188): watch pods
+matching a label selector; a Running+Ready pod with an IP gets a ZMQ
+subscriber at ``tcp://<podIP>:<port>``, anything else (deleted, not
+ready, IP-less) gets its subscriber removed.
+
+The image ships no kubernetes client, so this speaks the watch API
+directly over stdlib HTTP — in-cluster service-account auth, list to a
+``resourceVersion``, then a chunked ``?watch=true`` stream of
+ADDED/MODIFIED/DELETED JSON lines, re-listing on 410 Gone.  The same
+predicates run either way, so tests drive it with a plain fake API
+server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
+    SubscriberManager,
+)
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("kvevents.pod_reconciler")
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+# The fleet's serving pods carry this label (reference: pool.go:35).
+DEFAULT_LABEL_SELECTOR = "llm-d.ai/inferenceServing=true"
+
+
+@dataclass
+class PodReconcilerConfig:
+    namespace: Optional[str] = None  # None = service-account namespace
+    label_selector: str = DEFAULT_LABEL_SELECTOR
+    socket_port: int = 5557
+    # Subscriber ids are k8s namespaced names, not the engines'
+    # published pod ids — match every kv topic on each pod's socket.
+    topic_filter: str = "kv@"
+    # Overrides for out-of-cluster use / tests; in-cluster values are
+    # discovered from the environment and service-account files.
+    api_server: Optional[str] = None
+    token: Optional[str] = None
+    ca_cert_path: Optional[str] = None
+    reconnect_seconds: float = 5.0
+
+
+class KubeClient:
+    """The two API calls the reconciler needs: list + watch pods."""
+
+    def __init__(self, config: PodReconcilerConfig) -> None:
+        self.config = config
+        self.api_server = config.api_server or self._in_cluster_server()
+        self.token = config.token or self._read_service_account("token")
+        self.namespace = config.namespace or self._read_service_account(
+            "namespace"
+        )
+        self._ssl_context = self._build_ssl_context()
+
+    @staticmethod
+    def _in_cluster_server() -> str:
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError(
+                "not in-cluster (KUBERNETES_SERVICE_HOST unset) and no "
+                "api_server configured"
+            )
+        return f"https://{host}:{port}"
+
+    @staticmethod
+    def _read_service_account(name: str) -> Optional[str]:
+        path = os.path.join(SERVICE_ACCOUNT_DIR, name)
+        if os.path.isfile(path):
+            with open(path) as handle:
+                return handle.read().strip()
+        return None
+
+    def _build_ssl_context(self) -> Optional[ssl.SSLContext]:
+        if not self.api_server.startswith("https"):
+            return None
+        ca_path = self.config.ca_cert_path or os.path.join(
+            SERVICE_ACCOUNT_DIR, "ca.crt"
+        )
+        if os.path.isfile(ca_path):
+            return ssl.create_default_context(cafile=ca_path)
+        return ssl.create_default_context()
+
+    def _open(self, path: str, timeout: Optional[float]):
+        request = urllib.request.Request(self.api_server + path)
+        if self.token:
+            request.add_header("Authorization", f"Bearer {self.token}")
+        return urllib.request.urlopen(
+            request, timeout=timeout, context=self._ssl_context
+        )
+
+    def _pods_path(self, query: Dict[str, str]) -> str:
+        namespace = self.namespace or "default"
+        return (
+            f"/api/v1/namespaces/{namespace}/pods?"
+            + urllib.parse.urlencode(query)
+        )
+
+    def list_pods(self) -> dict:
+        query = {"labelSelector": self.config.label_selector}
+        with self._open(self._pods_path(query), timeout=30) as response:
+            return json.load(response)
+
+    def watch_pods(self, resource_version: str):
+        """Yield watch events until the stream ends or errors."""
+        query = {
+            "labelSelector": self.config.label_selector,
+            "watch": "true",
+            "resourceVersion": resource_version,
+            "allowWatchBookmarks": "true",
+        }
+        # No read timeout: the server holds the stream open between
+        # events; the poll loop handles liveness via reconnects.
+        with self._open(self._pods_path(query), timeout=None) as response:
+            for line in response:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+
+class PodReconciler:
+    """Keeps subscriber state converged with the live pod set."""
+
+    def __init__(
+        self,
+        subscriber_manager: SubscriberManager,
+        config: Optional[PodReconcilerConfig] = None,
+        client: Optional[KubeClient] = None,
+    ) -> None:
+        self.config = config or PodReconcilerConfig()
+        self.subscriber_manager = subscriber_manager
+        self.client = client or KubeClient(self.config)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- predicates (pod_reconciler.go:135-158) --
+
+    @staticmethod
+    def _pod_key(pod: dict) -> str:
+        metadata = pod.get("metadata", {})
+        return f"{metadata.get('namespace', '')}/{metadata.get('name', '')}"
+
+    @staticmethod
+    def should_subscribe(pod: dict) -> bool:
+        """Running, has an IP, and Ready."""
+        status = pod.get("status", {})
+        if status.get("phase") != "Running":
+            return False
+        if not status.get("podIP"):
+            return False
+        return any(
+            condition.get("type") == "Ready"
+            and condition.get("status") == "True"
+            for condition in status.get("conditions", [])
+        )
+
+    def _endpoint(self, pod: dict) -> str:
+        ip = pod["status"]["podIP"].strip()
+        if ":" in ip:  # IPv6
+            ip = f"[{ip}]"
+        return f"tcp://{ip}:{self.config.socket_port}"
+
+    # -- reconciliation --
+
+    def reconcile(self, event_type: str, pod: dict) -> None:
+        key = self._pod_key(pod)
+        if event_type == "DELETED":
+            self.subscriber_manager.remove_subscriber(key)
+            return
+        if self.should_subscribe(pod):
+            self.subscriber_manager.ensure_subscriber(
+                key, self._endpoint(pod), topic_filter=self.config.topic_filter
+            )
+        else:
+            self.subscriber_manager.remove_subscriber(key)
+
+    def reconcile_list(self, pod_list: dict) -> str:
+        """Full resync from a list response; returns its resourceVersion."""
+        seen = set()
+        for pod in pod_list.get("items", []):
+            self.reconcile("MODIFIED", pod)
+            seen.add(self._pod_key(pod))
+        for pod_id in self.subscriber_manager.active_pods():
+            # "/" distinguishes reconciler-owned ids from manual ones
+            # (e.g. the global-socket "local-subscriber").
+            if "/" in pod_id and pod_id not in seen:
+                self.subscriber_manager.remove_subscriber(pod_id)
+        return pod_list.get("metadata", {}).get("resourceVersion", "0")
+
+    # -- watch loop --
+
+    def run_once(self) -> None:
+        """One list+watch cycle (returns when the stream drops)."""
+        resource_version = self.reconcile_list(self.client.list_pods())
+        for event in self.client.watch_pods(resource_version):
+            if self._stop.is_set():
+                return
+            kind = event.get("type", "")
+            if kind == "BOOKMARK":
+                continue
+            if kind == "ERROR":
+                # e.g. 410 Gone: resourceVersion too old -> re-list.
+                logger.info("watch error event %s; re-listing", event)
+                return
+            obj = event.get("object", {})
+            if obj.get("kind") not in (None, "Pod"):
+                continue
+            self.reconcile(kind, obj)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception as exc:
+                logger.warning(
+                    "pod watch failed (%s); retrying in %.0fs",
+                    exc,
+                    self.config.reconnect_seconds,
+                )
+            self._stop.wait(self.config.reconnect_seconds)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="pod-reconciler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -37,22 +37,37 @@ class SubscriberManager:
         self._lock = threading.Lock()
         self._subscribers: Dict[str, ZMQSubscriber] = {}
 
-    def ensure_subscriber(self, pod_identifier: str, endpoint: str) -> bool:
-        """Start (or restart on endpoint change) a subscriber for the pod.
+    def ensure_subscriber(
+        self,
+        pod_identifier: str,
+        endpoint: str,
+        topic_filter: Optional[str] = None,
+    ) -> bool:
+        """Start (or restart on endpoint/filter change) a subscriber.
 
+        ``topic_filter=None`` subscribes to ``kv@<pod_identifier>@`` only;
+        pass ``"kv@"`` when the subscriber identity differs from the
+        engine's published pod id (scheduler-plugin discovery, global
+        socket mode — reference: EnsureSubscriber's topicFilter arg).
         Returns True if a new subscriber was started.
         """
         stale: Optional[ZMQSubscriber] = None
         with self._lock:
             existing = self._subscribers.get(pod_identifier)
             if existing is not None:
-                if existing.config.endpoint == endpoint:
+                if (
+                    existing.config.endpoint == endpoint
+                    and existing.config.topic_filter == topic_filter
+                ):
                     return False
                 logger.info(
-                    "endpoint change for pod %s: %s -> %s; restarting",
+                    "subscription change for pod %s: endpoint %s -> %s, "
+                    "topic filter %r -> %r; restarting",
                     pod_identifier,
                     existing.config.endpoint,
                     endpoint,
+                    existing.config.topic_filter,
+                    topic_filter,
                 )
                 stale = existing
                 del self._subscribers[pod_identifier]
@@ -61,6 +76,7 @@ class SubscriberManager:
                 ZMQSubscriberConfig(
                     endpoint=endpoint,
                     pod_identifier=pod_identifier,
+                    topic_filter=topic_filter,
                     bind=self._bind,
                 ),
                 self._sink,

@@ -1,0 +1,21 @@
+"""Inference-scheduler integration (reference: examples/kv_cache_aware_scorer)."""
+
+from llm_d_kv_cache_manager_tpu.scheduler.precise_scorer import (
+    ChatCompletionsBody,
+    ChatMessage,
+    CompletionsBody,
+    LLMRequest,
+    Pod,
+    PrecisePrefixCacheScorer,
+    PrecisePrefixCacheScorerConfig,
+)
+
+__all__ = [
+    "ChatCompletionsBody",
+    "ChatMessage",
+    "CompletionsBody",
+    "LLMRequest",
+    "Pod",
+    "PrecisePrefixCacheScorer",
+    "PrecisePrefixCacheScorerConfig",
+]

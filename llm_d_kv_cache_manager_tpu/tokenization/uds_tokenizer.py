@@ -15,6 +15,7 @@ from typing import Optional
 
 import grpc
 
+from llm_d_kv_cache_manager_tpu.api import tokenizer_pb2
 from llm_d_kv_cache_manager_tpu.api.grpc_services import (
     TokenizationServiceStub,
 )
@@ -60,8 +61,6 @@ class UdsTokenizer:
 
     def initialize_model(self, model_name: str) -> None:
         """Pre-warm with retry/backoff (uds_tokenizer.go:113-142)."""
-        from llm_d_kv_cache_manager_tpu.api import tokenizer_pb2
-
         last_error: Optional[Exception] = None
         for attempt in range(INIT_RETRIES):
             try:
@@ -76,7 +75,8 @@ class UdsTokenizer:
                 last_error = RuntimeError(response.error_message)
             except grpc.RpcError as exc:
                 last_error = exc
-            time.sleep(INIT_BACKOFF_SECONDS * (2**attempt))
+            if attempt < INIT_RETRIES - 1:
+                time.sleep(INIT_BACKOFF_SECONDS * (2**attempt))
         raise RuntimeError(
             f"tokenizer init failed for {model_name!r} after "
             f"{INIT_RETRIES} attempts: {last_error}"
@@ -85,8 +85,6 @@ class UdsTokenizer:
     def encode(
         self, prompt: str, model_name: str, add_special_tokens: bool
     ) -> Encoding:
-        from llm_d_kv_cache_manager_tpu.api import tokenizer_pb2
-
         response = self._stub.Tokenize(
             tokenizer_pb2.TokenizeRequest(
                 input=prompt,

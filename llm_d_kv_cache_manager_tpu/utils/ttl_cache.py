@@ -1,0 +1,97 @@
+"""TTL cache with eviction callbacks.
+
+Small, dependency-free equivalent of the ``ttlcache`` library the
+reference's scheduler plugin uses for subscriber lifecycle
+(examples/kv_cache_aware_scorer/kvcache_aware_scorer.go:126-140): every
+``set`` refreshes the key's deadline, expired keys fire ``on_evict``,
+and an optional background thread sweeps periodically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Generic, Optional, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class TTLCache(Generic[K, V]):
+    def __init__(
+        self,
+        ttl_seconds: float,
+        on_evict: Optional[Callable[[K, V], None]] = None,
+    ) -> None:
+        self.ttl_seconds = ttl_seconds
+        self._on_evict = on_evict
+        self._entries: Dict[K, tuple] = {}  # key -> (value, deadline)
+        self._lock = threading.Lock()
+        self._sweeper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def set(self, key: K, value: V, ttl_seconds: Optional[float] = None):
+        """Insert or refresh; refreshing resets the deadline."""
+        deadline = time.monotonic() + (ttl_seconds or self.ttl_seconds)
+        with self._lock:
+            self._entries[key] = (value, deadline)
+
+    def get(self, key: K) -> Optional[V]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            value, deadline = entry
+            if deadline < time.monotonic():
+                del self._entries[key]
+            else:
+                return value
+        if self._on_evict is not None:
+            self._on_evict(key, value)
+        return None
+
+    def delete(self, key: K) -> bool:
+        """Remove without firing ``on_evict`` (explicit removal, not
+        expiry — mirrors ttlcache's EvictionReason distinction)."""
+        with self._lock:
+            return self._entries.pop(key, None) is not None
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._entries)
+
+    def sweep(self) -> int:
+        """Evict every expired key now; returns the eviction count."""
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            for key, (value, deadline) in list(self._entries.items()):
+                if deadline < now:
+                    del self._entries[key]
+                    expired.append((key, value))
+        if self._on_evict is not None:
+            for key, value in expired:
+                self._on_evict(key, value)
+        return len(expired)
+
+    def start_sweeper(self, interval_seconds: Optional[float] = None) -> None:
+        """Spawn the periodic cleaner (idempotent)."""
+        if self._sweeper is not None:
+            return
+        interval = interval_seconds or self.ttl_seconds
+
+        def loop() -> None:
+            while not self._stop.wait(interval):
+                self.sweep()
+
+        self._sweeper = threading.Thread(
+            target=loop, name="ttl-cache-sweeper", daemon=True
+        )
+        self._sweeper.start()
+
+    def stop_sweeper(self) -> None:
+        self._stop.set()
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=5)
+            self._sweeper = None
+        self._stop.clear()

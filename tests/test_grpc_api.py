@@ -211,6 +211,8 @@ class TestValueCodec:
             "flag": True,
             "items": ["a", 1, False],
             "nested": {"k": "v"},
+            "absent": None,
+            "empty": {},
         }
         assert value_to_python(python_to_value(payload)) == payload
 

@@ -1,0 +1,120 @@
+"""HTTP scoring service round trips (reference: examples/kv_events/online)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.api.http_service import serve
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+    LocalFastTokenizer,
+)
+from tests.helpers.tiny_tokenizer import (
+    build_transformers_tokenizer,
+    save_tokenizer_json,
+)
+
+MODEL = "test-model"
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+@pytest.fixture()
+def service(tmp_path):
+    tokenizer_dir = save_tokenizer_json(str(tmp_path), MODEL)
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=4),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                workers=2, model_name=MODEL
+            ),
+        ),
+        tokenizer=LocalFastTokenizer(tokenizer_dir),
+    )
+    indexer.chat_processor.register_tokenizer(
+        MODEL, build_transformers_tokenizer()
+    )
+    indexer.run()
+    server = serve(indexer, host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield indexer, base
+    server.shutdown()
+    indexer.shutdown()
+
+
+def post(base, path, obj):
+    request = urllib.request.Request(
+        base + path,
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(request, timeout=10) as response:
+        return response.status, json.load(response)
+
+
+def seed(indexer, prompt, pod):
+    tokens = indexer.tokenization_pool.tokenize(prompt, MODEL, None)
+    keys = indexer.token_processor.tokens_to_kv_block_keys(
+        EMPTY_BLOCK_HASH, tokens, MODEL
+    )
+    indexer.kv_block_index.add(keys, keys, [PodEntry(pod, "hbm")])
+
+
+class TestHTTPService:
+    def test_score_completions(self, service):
+        indexer, base = service
+        seed(indexer, PROMPT, "pod-a")
+        status, scores = post(
+            base,
+            "/score_completions",
+            {"prompt": PROMPT, "model": MODEL},
+        )
+        assert status == 200
+        assert scores["pod-a"] > 0
+
+    def test_score_chat_completions(self, service):
+        indexer, base = service
+        rendered = "<|user|> hello world <|assistant|>"
+        seed(indexer, rendered, "pod-chat")
+        status, scores = post(
+            base,
+            "/score_chat_completions",
+            {
+                "model": MODEL,
+                "messages": [{"role": "user", "content": "hello world"}],
+            },
+        )
+        assert status == 200
+        assert scores.get("pod-chat", 0) > 0
+
+    def test_missing_prompt_400(self, service):
+        _, base = service
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(base, "/score_completions", {"model": MODEL})
+        assert err.value.code == 400
+
+    def test_metrics_and_healthz(self, service):
+        indexer, base = service
+        seed(indexer, PROMPT, "pod-a")
+        post(base, "/score_completions", {"prompt": PROMPT, "model": MODEL})
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            body = resp.read().decode()
+        assert "kvtpu_kvcache_index_lookup_requests_total" in body
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            assert json.load(resp)["status"] == "ok"
+
+    def test_unknown_path_404(self, service):
+        _, base = service
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(base, "/nope", {})
+        assert err.value.code == 404
