@@ -47,6 +47,9 @@ class RespClient:
         self._sock = socket.create_connection(
             (self._host, self._port), timeout=self._timeout
         )
+        # Small request/reply packets: Nagle + delayed ACK otherwise adds
+        # ~40ms stalls per pipelined round trip.
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._reader = self._sock.makefile("rb")
 
     def close(self) -> None:

@@ -29,6 +29,10 @@ def _bulk(data) -> bytes:
 
 
 class _Handler(socketserver.StreamRequestHandler):
+    # Real Redis disables Nagle on accepted sockets; without this, each
+    # small per-command reply stalls ~40ms on the peer's delayed ACK.
+    disable_nagle_algorithm = True
+
     def handle(self) -> None:
         state: _State = self.server.state  # type: ignore[attr-defined]
         while True:

@@ -1,0 +1,99 @@
+"""Index microbenchmarks: Add/Lookup across backends.
+
+Counterpart of the reference's profiling harness
+(tests/profiling/kv_cache_index/index_benchmark_test.go:97-197):
+fixed-seed key sets, per-backend Add and Lookup timings, the in-process
+RESP server standing in for Redis (their miniredis pattern).
+
+    python tests/profiling/index_benchmark.py [--keys 10000] [--pods 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "..")
+)
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (  # noqa: E402
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (  # noqa: E402
+    InMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (  # noqa: E402
+    CostAwareIndexConfig,
+    InMemoryIndexConfig,
+    PodEntry,
+    RedisIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (  # noqa: E402
+    RedisIndex,
+)
+from tests.helpers.miniresp import MiniRespServer  # noqa: E402
+
+SEED = 42
+LOOKUP_CHAIN = 64  # keys per lookup (a ~1k-token prompt at block=16)
+
+
+def bench_backend(name: str, index, n_keys: int, n_pods: int) -> dict:
+    rng = random.Random(SEED)
+    keys = [rng.getrandbits(64) for _ in range(n_keys)]
+    entries = [
+        [PodEntry(f"pod-{i % n_pods}", "hbm")] for i in range(n_keys)
+    ]
+
+    start = time.perf_counter()
+    for i, key in enumerate(keys):
+        index.add([key], [key], entries[i])
+    add_seconds = time.perf_counter() - start
+
+    lookups = 0
+    start = time.perf_counter()
+    for offset in range(0, n_keys - LOOKUP_CHAIN, LOOKUP_CHAIN):
+        index.lookup(keys[offset:offset + LOOKUP_CHAIN], None)
+        lookups += 1
+    lookup_seconds = time.perf_counter() - start
+
+    return {
+        "backend": name,
+        "add_us_per_key": 1e6 * add_seconds / n_keys,
+        "lookup_us_per_chain": 1e6 * lookup_seconds / max(lookups, 1),
+        "chain_len": LOOKUP_CHAIN,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--keys", type=int, default=10_000)
+    parser.add_argument("--pods", type=int, default=8)
+    args = parser.parse_args()
+
+    resp = MiniRespServer()
+    backends = [
+        ("in_memory", InMemoryIndex(InMemoryIndexConfig(size=args.keys * 2))),
+        (
+            "cost_aware",
+            CostAwareMemoryIndex(
+                CostAwareIndexConfig(max_cost_bytes=2 << 30)
+            ),
+        ),
+        ("redis(miniresp)", RedisIndex(RedisIndexConfig(address=resp.address))),
+    ]
+    try:
+        for name, index in backends:
+            print(
+                json.dumps(bench_backend(name, index, args.keys, args.pods))
+            )
+    finally:
+        resp.close()
+
+
+if __name__ == "__main__":
+    main()
