@@ -17,9 +17,14 @@ otherwise).
 - Read path is the real one: the precise scheduler calls
   Indexer.get_pod_scores (tokenize -> chained block hashes -> index
   lookup -> tier-weighted longest-prefix score) and routes argmax.
-- TTFT per request = routing time + real prefill time: a pod with the
-  prefix cached runs ``prefill_continue`` over the 256-token suffix
-  only; a miss runs ``prefill_paged`` over all 8448 tokens.
+- Load model: open-loop Poisson arrivals at 70% of the fleet's
+  ideal-routing capacity, each pod a FIFO server on a virtual clock
+  (the reference's headline regime — QPS-loaded fleets where
+  misrouting queues prefills, BASELINE.md §1-2).  Service times are
+  the *real measured* on-device prefill times: a pod with the prefix
+  cached runs ``prefill_continue`` over the 256-token suffix only; a
+  miss runs ``prefill_paged`` over all 8448 tokens.
+- TTFT per request = routing + queue wait + service.
 
 Metric: p50-TTFT speedup of precise routing over round-robin — the
 BASELINE.json north star (target >= 3x at >= 60% prefix-cache hit
@@ -54,7 +59,12 @@ from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import Encoding
 MODEL_NAME = "bench/llama"
 NUM_PODS = 4
 NUM_GROUPS = 8
-REQS_PER_GROUP = 4
+REQS_PER_GROUP = 6
+# First arrivals are unavoidable cold misses under ANY scheduler; the
+# reference's harness likewise excludes its warmup stage from reported
+# percentiles.  Stats cover arrivals after this index (both schedulers
+# share the arrival order, so the window is identical).
+WARMUP_REQUESTS = NUM_GROUPS
 PREFIX_TOKENS = 8192  # benchmark 1's 8k shared system prompt
 SUFFIX_TOKENS = 256
 BLOCK_SIZE = 16
@@ -221,15 +231,45 @@ def publish_events(
     )
 
 
+def measure_readback_rtt() -> float:
+    """Host->device->host round-trip floor for a trivial readback.
+
+    TTFT sampling ends with an on-device argmax read back to the host;
+    on a real TPU VM that costs microseconds, but through a remote
+    device tunnel it adds a fixed ~tens-of-ms RPC that is not prefill
+    compute.  Subtracting this floor keeps service times (and so the
+    queueing model) faithful to what a serving pod would measure
+    locally."""
+    probe = jnp.arange(8, dtype=jnp.int32)
+    int(jnp.sum(probe))  # drain any queued work
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        int(jnp.sum(probe))
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
+
+
 def run_fleet(
     scheduler: str,
     requests,
     params,
     prefill_full,
     prefill_suffix,
+    arrivals: Sequence[float],
+    readback_rtt: float = 0.0,
 ) -> Tuple[List[float], float]:
     """Run the request stream under one scheduler; returns (TTFTs, hit
-    rate).  A fresh indexer + event pool + pods per run."""
+    rate).  A fresh indexer + event pool + pods per run.
+
+    Open-loop load model (the reference's headline regime —
+    BASELINE.md §1: Poisson arrivals at fixed QPS against N pods, where
+    misrouting makes prefill queues pile up): requests *arrive* at
+    ``arrivals[i]`` on a virtual clock; each pod is a FIFO server.  The
+    prefill itself runs for real on the device and its measured wall
+    time is the service time; queueing is then
+    ``start = max(arrival, pod_free_at)`` and
+    ``TTFT = routing + (start - arrival) + service``."""
     indexer = Indexer(
         IndexerConfig(
             token_processor_config=TokenProcessorConfig(
@@ -252,8 +292,9 @@ def run_fleet(
     ttfts: List[float] = []
     hits = 0
     rr_next = 0
+    pod_free_at = {p.name: 0.0 for p in pods}
     try:
-        for group, text, tokens in requests:
+        for (group, text, tokens), arrival in zip(requests, arrivals):
             t0 = time.perf_counter()
             if scheduler == "precise":
                 scores = indexer.get_pod_scores(
@@ -271,6 +312,8 @@ def run_fleet(
                 pod = pods[rr_next % NUM_PODS]
                 rr_next += 1
 
+            routing_seconds = time.perf_counter() - t0
+
             hashes = block_hash_chain(tokens)
             cached_ids = pod.cached_prefix_blocks(hashes)
             # Suffix blocks never repeat across requests, so a hit is
@@ -278,6 +321,7 @@ def run_fleet(
             # misses (single compiled suffix shape).
             n_prefix_blocks = PREFIX_TOKENS // BLOCK_SIZE
             token_arr = np.asarray(tokens, np.int32)
+            service_start = time.perf_counter()
             if len(cached_ids) >= n_prefix_blocks:
                 hits += 1
                 new_ids, evicted = pod.alloc(len(hashes) - n_prefix_blocks)
@@ -300,10 +344,19 @@ def run_fleet(
                 )
                 first_new = 0
                 block_ids = new_ids
-            # TTFT ends when the first sampled token reaches the host
+            # Service ends when the first sampled token reaches the host
             # (the same on-device argmax + readback both paths).
             int(jnp.argmax(logits[0, -1]))
-            ttfts.append(time.perf_counter() - t0)
+            service_seconds = max(
+                time.perf_counter() - service_start - readback_rtt, 1e-4
+            )
+            queue_start = max(arrival, pod_free_at[pod.name])
+            pod_free_at[pod.name] = queue_start + service_seconds
+            ttfts.append(
+                routing_seconds
+                + (queue_start - arrival)
+                + service_seconds
+            )
 
             # Register only newly-written blocks: re-registering the hit
             # prefix would resurrect hashes that alloc() just evicted when
@@ -340,32 +393,63 @@ def main() -> None:
         ),
         donate_argnums=(2,),
     )
-    # Warm both shapes so compile time stays out of the TTFT samples.
+    # Warm both shapes so compile time stays out of the TTFT samples,
+    # and measure per-path service times to place the arrival rate.
     warm = SimPod("warm", params)
     full_ids, _ = warm.alloc(TOTAL_TOKENS // BLOCK_SIZE)
     tok = jnp.zeros((1, TOTAL_TOKENS), jnp.int32)
-    logits, warm.kv = prefill_full(
-        params, tok, warm.kv, jnp.asarray([full_ids], jnp.int32)
-    )
-    int(jnp.argmax(logits[0, -1]))
-    logits, warm.kv = prefill_suffix(
-        params,
-        tok[:, PREFIX_TOKENS:],
-        warm.kv,
-        jnp.asarray([full_ids], jnp.int32),
-    )
-    int(jnp.argmax(logits[0, -1]))
+    t_miss = t_hit = float("inf")
+    readback_rtt = 0.0
+    for _ in range(2):  # second pass = compiled, warm path
+        t0 = time.perf_counter()
+        logits, warm.kv = prefill_full(
+            params, tok, warm.kv, jnp.asarray([full_ids], jnp.int32)
+        )
+        int(jnp.argmax(logits[0, -1]))
+        t_miss = min(t_miss, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        logits, warm.kv = prefill_suffix(
+            params,
+            tok[:, PREFIX_TOKENS:],
+            warm.kv,
+            jnp.asarray([full_ids], jnp.int32),
+        )
+        int(jnp.argmax(logits[0, -1]))
+        t_hit = min(t_hit, time.perf_counter() - t0)
+        readback_rtt = measure_readback_rtt()
+    t_miss = max(t_miss - readback_rtt, 1e-4)
+    t_hit = max(t_hit - readback_rtt, 1e-4)
     del warm, logits
 
+    # Arrival rate: 70% of the fleet's capacity under *ideal* routing
+    # (first request per group misses, the rest hit).  A well-routed
+    # fleet is comfortably stable there; a hit-blind scheduler's
+    # effective service time is ~t_miss, pushing it past saturation so
+    # prefill queues build — the reference's headline mechanism
+    # (BASELINE.md §1-2: TTFT seconds-vs-minutes at the same QPS).
+    ideal_miss_fraction = NUM_GROUPS / len(requests)
+    ideal_service = (
+        ideal_miss_fraction * t_miss + (1 - ideal_miss_fraction) * t_hit
+    )
+    qps = 0.7 * NUM_PODS / ideal_service
+    arrival_rng = random.Random(7)
+    arrivals: List[float] = []
+    clock = 0.0
+    for _ in requests:
+        clock += arrival_rng.expovariate(qps)
+        arrivals.append(clock)
+
     rr_ttfts, rr_hit = run_fleet(
-        "round_robin", requests, params, prefill_full, prefill_suffix
+        "round_robin", requests, params, prefill_full, prefill_suffix,
+        arrivals, readback_rtt,
     )
     pr_ttfts, pr_hit = run_fleet(
-        "precise", requests, params, prefill_full, prefill_suffix
+        "precise", requests, params, prefill_full, prefill_suffix,
+        arrivals, readback_rtt,
     )
 
-    p50_rr = float(np.percentile(rr_ttfts, 50))
-    p50_pr = float(np.percentile(pr_ttfts, 50))
+    p50_rr = float(np.percentile(rr_ttfts[WARMUP_REQUESTS:], 50))
+    p50_pr = float(np.percentile(pr_ttfts[WARMUP_REQUESTS:], 50))
     speedup = p50_rr / p50_pr if p50_pr > 0 else 0.0
     print(
         json.dumps(
@@ -379,6 +463,10 @@ def main() -> None:
                     "p50_ttft_round_robin_s": round(p50_rr, 5),
                     "prefix_cache_hit_rate_precise": round(pr_hit, 3),
                     "prefix_cache_hit_rate_round_robin": round(rr_hit, 3),
+                    "qps": round(qps, 2),
+                    "service_miss_s": round(t_miss, 4),
+                    "service_hit_s": round(t_hit, 4),
+                    "readback_rtt_s": round(readback_rtt, 4),
                     "device": jax.devices()[0].platform,
                     "requests": len(requests),
                 },

@@ -71,3 +71,72 @@ def test_jit_and_bf16():
         rtol=3e-2,
         atol=3e-2,
     )
+
+
+# ----------------------- pallas kernel (interpret) ------------------------
+
+from llm_d_kv_cache_manager_tpu.ops.flash_pallas import (  # noqa: E402
+    flash_gqa_attention_pallas,
+)
+
+
+@pytest.mark.parametrize(
+    "B,Tq,Tk,H,Hkv,D,q_offset",
+    [
+        (1, 512, 512, 4, 2, 64, 0),  # square causal, GQA
+        (2, 256, 1280, 8, 4, 64, 1024),  # continuation
+        (1, 300, 300, 4, 4, 128, 0),  # Tq not a q_block multiple
+        (1, 128, 896, 4, 2, 64, 768),  # Tk not a kv_chunk multiple
+    ],
+)
+def test_pallas_matches_dense(B, Tq, Tk, H, Hkv, D, q_offset):
+    """The TPU kernel in interpreter mode vs the dense reference; the
+    same code compiles on-chip (exercised by bench.py)."""
+    q, k, v = _qkv(jax.random.PRNGKey(7), B, Tq, Tk, H, Hkv, D)
+    q = q.astype(jnp.bfloat16)
+    k = k.astype(jnp.bfloat16)
+    v = v.astype(jnp.bfloat16)
+    dense = causal_gqa_attention(q, k, v, q_offset=q_offset)
+    got = flash_gqa_attention_pallas(
+        q, k, v, q_offset=q_offset, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32),
+        np.asarray(dense, np.float32),
+        rtol=0.05,
+        atol=0.05,
+    )
+
+
+def test_pallas_pad_rows_are_finite():
+    """Padded q rows (Tq % q_block != 0) must come back 0, not NaN —
+    q_block=32 forces real padding (40 -> 64) and the padded rows'
+    l==0 guard."""
+    q, k, v = _qkv(jax.random.PRNGKey(8), 1, 40, 40, 2, 2, 64)
+    got = flash_gqa_attention_pallas(
+        q.astype(jnp.bfloat16),
+        k.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16),
+        q_block=32,
+        interpret=True,
+    )
+    assert got.shape == q.shape
+    assert bool(jnp.all(jnp.isfinite(got.astype(jnp.float32))))
+    dense = causal_gqa_attention(
+        q.astype(jnp.bfloat16),
+        k.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32),
+        np.asarray(dense, np.float32),
+        rtol=0.05,
+        atol=0.05,
+    )
+
+
+def test_vmem_gate():
+    from llm_d_kv_cache_manager_tpu.ops.flash_pallas import fits_vmem
+
+    assert fits_vmem(8448, 128)  # the bench shape
+    assert not fits_vmem(32768, 128)  # long-context falls back to scan
