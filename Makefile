@@ -34,9 +34,12 @@ proto:
 	protoc -I protos --python_out=. protos/indexer.proto protos/tokenizer.proto
 
 # What the driver runs: single-chip compile check + virtual multi-chip.
+# The multichip check forces the CPU platform via jax.config too — a
+# sitecustomize may pre-register an accelerator, and config beats env
+# (same override as tests/conftest.py).
 graft-check:
 	$(PYTHON) -c "import __graft_entry__ as g; fn, args = g.entry(); import jax; jax.jit(fn)(*args); print('entry ok')"
-	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip ok')"
+	$(CPU_ENV) $(PYTHON) -c "import jax; jax.config.update('jax_platforms', 'cpu'); import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip ok')"
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -60,11 +60,6 @@ MODEL_NAME = "bench/llama"
 NUM_PODS = 4
 NUM_GROUPS = 8
 REQS_PER_GROUP = 6
-# First arrivals are unavoidable cold misses under ANY scheduler; the
-# reference's harness likewise excludes its warmup stage from reported
-# percentiles.  Stats cover arrivals after this index (both schedulers
-# share the arrival order, so the window is identical).
-WARMUP_REQUESTS = NUM_GROUPS
 PREFIX_TOKENS = 8192  # benchmark 1's 8k shared system prompt
 SUFFIX_TOKENS = 256
 BLOCK_SIZE = 16
@@ -448,8 +443,20 @@ def main() -> None:
         arrivals, readback_rtt,
     )
 
-    p50_rr = float(np.percentile(rr_ttfts[WARMUP_REQUESTS:], 50))
-    p50_pr = float(np.percentile(pr_ttfts[WARMUP_REQUESTS:], 50))
+    # Each group's FIRST arrival is an unavoidable cold miss under ANY
+    # scheduler (the reference's harness likewise excludes its warmup
+    # stage); percentiles cover the steady-state samples.  Both
+    # schedulers share the arrival order, so the window is identical.
+    seen_groups: set = set()
+    warmup_idx = set()
+    for i, (group, _, _) in enumerate(requests):
+        if group not in seen_groups:
+            seen_groups.add(group)
+            warmup_idx.add(i)
+    rr_steady = [t for i, t in enumerate(rr_ttfts) if i not in warmup_idx]
+    pr_steady = [t for i, t in enumerate(pr_ttfts) if i not in warmup_idx]
+    p50_rr = float(np.percentile(rr_steady, 50))
+    p50_pr = float(np.percentile(pr_steady, 50))
     speedup = p50_rr / p50_pr if p50_pr > 0 else 0.0
     print(
         json.dumps(

@@ -186,7 +186,9 @@ def _prefill_attention(q, k, v, cfg: LlamaConfig, q_offset=0, use_flash=True):
             q.shape[1] >= cfg.flash_attention_min_len
             and isinstance(q_offset, int)
             and jax.default_backend() == "tpu"
-            and flash_pallas.fits_vmem(k.shape[1], k.shape[-1])
+            and flash_pallas.fits_vmem(
+                k.shape[1], k.shape[-1], jnp.dtype(k.dtype).itemsize
+            )
         ):
             # Beyond the VMEM budget the scan op streams K/V from HBM
             # at any length (e.g. 32k+ prompts).

@@ -1,0 +1,280 @@
+"""Mixtral-style sparse-MoE decoder: the expert-parallel model family.
+
+Same attention stack as the flagship dense model (models/llama.py —
+GQA, RoPE, RMSNorm, bf16 on the MXU) with the MLP replaced by a top-k
+routed expert layer in the GShard/Switch formulation that maps onto
+TPUs: static expert capacity, one-hot dispatch/combine einsums (all
+MXU contractions, no dynamic shapes), tokens over capacity dropped to
+the residual path.  Experts shard over the mesh's ``ep`` axis
+(parallel/mesh.py) — under pjit the dispatch einsum becomes the
+all-to-all over ICI, which XLA inserts from the sharding constraints;
+``tp`` additionally shards each expert's hidden dim.
+
+The reference is a serving control plane with no model zoo; this
+family exists for the TPU serving/benchmark stack (SURVEY.md §2.3:
+fleet benchmarks ran Qwen3-32B and Llama — MoE covers the third major
+architecture class) and to make the canonical mesh's ``ep`` axis real.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from llm_d_kv_cache_manager_tpu.models.llama import (
+    _logits,
+    _prefill_attention,
+    _qkv,
+    _rms_norm,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    d_model: int = 1024
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 2816  # per-expert hidden dim
+    n_experts: int = 8
+    top_k: int = 2
+    # Static per-expert slot budget: capacity = ceil(top_k * T / E) *
+    # factor.  Overflowing tokens fall back to the residual stream.
+    capacity_factor: float = 1.25
+    rope_theta: float = 500000.0
+    block_size: int = 16
+    dtype: str = "bfloat16"
+    flash_attention_min_len: int = 1024
+    # Weight of the load-balancing auxiliary loss (Switch §2.2 form).
+    router_aux_weight: float = 0.01
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def capacity(self, tokens_per_batch: int) -> int:
+        raw = self.top_k * tokens_per_batch / self.n_experts
+        return max(int(math.ceil(raw * self.capacity_factor)), 1)
+
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> Params:
+    dtype = jnp.dtype(cfg.dtype)
+    L, D, H, Hkv, Dh, F, E = (
+        cfg.n_layers,
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+        cfg.d_ff,
+        cfg.n_experts,
+    )
+    keys = jax.random.split(rng, 9)
+
+    def norm_init(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5
+        ).astype(dtype)
+
+    return {
+        "embed": norm_init(keys[0], (cfg.vocab_size, D), D),
+        "layers": {
+            "ln1": jnp.ones((L, D), dtype),
+            "ln2": jnp.ones((L, D), dtype),
+            "wq": norm_init(keys[1], (L, D, H, Dh), D),
+            "wk": norm_init(keys[2], (L, D, Hkv, Dh), D),
+            "wv": norm_init(keys[3], (L, D, Hkv, Dh), D),
+            "wo": norm_init(keys[4], (L, H, Dh, D), H * Dh),
+            # Router in f32: tiny, and logits precision decides routing.
+            "router": jax.random.normal(keys[5], (L, D, E), jnp.float32)
+            * D**-0.5,
+            "w_gate": norm_init(keys[6], (L, E, D, F), D),
+            "w_up": norm_init(keys[7], (L, E, D, F), D),
+            "w_down": norm_init(keys[8], (L, E, F, D), F),
+        },
+        "ln_f": jnp.ones((D,), dtype),
+    }
+
+
+def param_pspecs(cfg: MoEConfig) -> Params:
+    """PartitionSpec pytree (axes: parallel/mesh.py): experts over
+    ``ep``, per-expert hidden over ``tp``, stacked layers over ``pp``."""
+    return {
+        "embed": P(None, "tp"),
+        "layers": {
+            "ln1": P("pp", None),
+            "ln2": P("pp", None),
+            "wq": P("pp", None, "tp", None),
+            "wk": P("pp", None, "tp", None),
+            "wv": P("pp", None, "tp", None),
+            "wo": P("pp", "tp", None, None),
+            "router": P("pp", None, None),
+            "w_gate": P("pp", "ep", None, "tp"),
+            "w_up": P("pp", "ep", None, "tp"),
+            "w_down": P("pp", "ep", "tp", None),
+        },
+        "ln_f": P(None),
+    }
+
+
+def _route(
+    x: jnp.ndarray, router: jnp.ndarray, cfg: MoEConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k routing with static capacity.
+
+    x: [S, D] flattened tokens.  Returns (dispatch [S, E, C] one-hot,
+    combine [S, E, C] gate-weighted, aux load-balancing loss)."""
+    S, _ = x.shape
+    E, C = cfg.n_experts, cfg.capacity(S)
+
+    logits = x.astype(jnp.float32) @ router  # [S, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Top-k selection as k sequential argmax rounds (static shapes).
+    remaining = probs
+    dispatch = jnp.zeros((S, E, C), jnp.float32)
+    combine = jnp.zeros((S, E, C), jnp.float32)
+    # Slots already taken per expert, accumulated across rounds.
+    fill = jnp.zeros((E,), jnp.int32)
+    picked_gates = []
+    picks = []
+    for _ in range(cfg.top_k):
+        choice = jnp.argmax(remaining, axis=-1)  # [S]
+        gate = jnp.take_along_axis(
+            probs, choice[:, None], axis=-1
+        )[:, 0]
+        picks.append(choice)
+        picked_gates.append(gate)
+        remaining = remaining * (
+            1.0 - jax.nn.one_hot(choice, E, dtype=jnp.float32)
+        )
+
+    # Normalize the k gates per token (Mixtral renormalizes top-k).
+    gate_sum = sum(picked_gates)
+    for choice, gate in zip(picks, picked_gates):
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)  # [S, E]
+        # Position of each token within its chosen expert's queue:
+        # tokens are served in sequence order (cumsum), plus slots the
+        # earlier rounds already filled.
+        position = (
+            jnp.cumsum(onehot, axis=0) - 1.0 + fill[None, :].astype(
+                jnp.float32
+            )
+        )  # [S, E]
+        position_tok = jnp.sum(position * onehot, axis=-1)  # [S]
+        keep = position_tok < C  # capacity drop
+        slot = jax.nn.one_hot(
+            jnp.where(keep, position_tok, C).astype(jnp.int32),
+            C,
+            dtype=jnp.float32,
+        )  # [S, C] (dropped tokens one-hot nothing)
+        contrib = onehot[:, :, None] * slot[:, None, :]  # [S, E, C]
+        dispatch = dispatch + contrib * keep[:, None, None]
+        combine = combine + contrib * (
+            (gate / jnp.maximum(gate_sum, 1e-9)) * keep
+        )[:, None, None]
+        fill = fill + jnp.sum(
+            onehot * keep[:, None], axis=0
+        ).astype(jnp.int32)
+
+    # Load-balancing aux loss: E * sum_e f_e * p_e (Switch/GShard).
+    token_frac = jnp.mean(
+        jax.nn.one_hot(picks[0], E, dtype=jnp.float32), axis=0
+    )
+    prob_frac = jnp.mean(probs, axis=0)
+    aux = cfg.n_experts * jnp.sum(token_frac * prob_frac)
+    return dispatch, combine, aux
+
+
+def _constrain(x: jnp.ndarray, spec: P) -> jnp.ndarray:
+    """Sharding constraint that is a no-op outside a mesh context
+    (single-device tests and the unsharded serving path)."""
+    try:
+        return lax.with_sharding_constraint(x, spec)
+    except RuntimeError:  # no mesh in context
+        return x
+
+
+def _moe_mlp(
+    x: jnp.ndarray, lp: Params, cfg: MoEConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Routed expert MLP.  x: [B, T, D] -> ([B, T, D], aux loss)."""
+    B, T, D = x.shape
+    flat = x.reshape(B * T, D)
+    dispatch, combine, aux = _route(flat, lp["router"], cfg)
+    dispatch = dispatch.astype(x.dtype)
+
+    # [S, E, C] x [S, D] -> expert batches [E, C, D]: under ep sharding
+    # this contraction IS the all-to-all (XLA SPMD inserts it).
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch, flat)
+    expert_in = _constrain(expert_in, P("ep", None, None))
+    gate = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"])
+    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_up"])
+    hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    expert_out = jnp.einsum("ecf,efd->ecd", hidden, lp["w_down"])
+    expert_out = _constrain(expert_out, P("ep", None, None))
+    out = jnp.einsum(
+        "sec,ecd->sd", combine.astype(x.dtype), expert_out
+    )
+    return out.reshape(B, T, D), aux
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: MoEConfig,
+    use_flash: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense forward: tokens [B, T] -> (logits [B, T, V], aux loss)."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    def layer(carry, lp):
+        x, aux = carry
+        h = _rms_norm(x, lp["ln1"])
+        q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
+        attn = _prefill_attention(q, k, v, cfg, use_flash=use_flash)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+        moe_out, layer_aux = _moe_mlp(_rms_norm(x, lp["ln2"]), lp, cfg)
+        return (x + moe_out, aux + layer_aux), None
+
+    (x, aux), _ = lax.scan(layer, (x, jnp.float32(0.0)), params["layers"])
+    return _logits(x, params), aux / cfg.n_layers
+
+
+def loss_fn(
+    params: Params, tokens: jnp.ndarray, cfg: MoEConfig
+) -> jnp.ndarray:
+    """Next-token cross entropy + router load-balancing loss."""
+    logits, aux = forward(params, tokens[:, :-1], cfg, use_flash=False)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean() + cfg.router_aux_weight * aux
+
+
+def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
+
+
+def train_step(
+    params: Params,
+    opt_state: Any,
+    tokens: jnp.ndarray,
+    cfg: MoEConfig,
+    optimizer: optax.GradientTransformation,
+) -> Tuple[Params, Any, jnp.ndarray]:
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss

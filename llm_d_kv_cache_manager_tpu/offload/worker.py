@@ -41,6 +41,7 @@ def host_dtype(name: str) -> np.dtype:
         return np.dtype(ml_dtypes.bfloat16)
     return np.dtype(name)
 
+from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
 from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import KVCachePool
 from llm_d_kv_cache_manager_tpu.native.engine import (
     JobStatus,
@@ -132,7 +133,8 @@ class DeviceToStorageHandler(_HandlerBase):
     def __init__(self, *args, event_sink: Optional[StoreEventSink] = None):
         super().__init__(*args)
         self._event_sink = event_sink
-        self._job_hashes: Dict[int, List[int]] = {}
+        # job_id -> (file hashes, payload bytes) until completion.
+        self._job_hashes: Dict[int, Tuple[List[int], int]] = {}
 
     def transfer_async(
         self, job_id: int, groups: Sequence[FileBlockGroup]
@@ -153,19 +155,24 @@ class DeviceToStorageHandler(_HandlerBase):
             # docstring: head-of-file == first blocks).
             buffers.append(np.ascontiguousarray(np.moveaxis(chunk, 1, 0)))
             cursor += len(ids)
-        self._job_hashes[job_id] = [h for h, _ in groups]
+        self._job_hashes[job_id] = (
+            [h for h, _ in groups],
+            sum(buffer.nbytes for buffer in buffers),
+        )
         self.engine.store(job_id, paths, buffers, skip_existing=True)
 
     def owns(self, job_id: int) -> bool:
         return job_id in self._job_hashes
 
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
-        hashes = self._job_hashes.pop(job_id, None)
-        if (
-            status == JobStatus.SUCCEEDED
-            and hashes
-            and self._event_sink is not None
-        ):
+        hashes, nbytes = self._job_hashes.pop(job_id, (None, 0))
+        METRICS.offload_jobs.labels("store", status.name.lower()).inc()
+        if status != JobStatus.SUCCEEDED:
+            return status
+        # Counted on success only, symmetric with the load path (bytes
+        # deduped by skip_existing still transit the gather+DMA).
+        METRICS.offload_bytes.labels("store").inc(nbytes)
+        if hashes and self._event_sink is not None:
             self._event_sink(hashes, SHARED_STORAGE_MEDIUM)
         return status
 
@@ -211,9 +218,13 @@ class StorageToDeviceHandler(_HandlerBase):
 
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
         pending = self._pending.pop(job_id, None)
+        METRICS.offload_jobs.labels("load", status.name.lower()).inc()
         if pending is None or status != JobStatus.SUCCEEDED:
             return status
         block_ids, buffers = pending
         host = np.concatenate([np.moveaxis(b, 0, 1) for b in buffers], axis=1)
+        METRICS.offload_bytes.labels("load").inc(
+            sum(buffer.nbytes for buffer in buffers)
+        )
         self.pool.scatter_from_host(block_ids, host)
         return status
