@@ -1,0 +1,64 @@
+"""Model checkpoint/resume over orbax.
+
+The reference's index is deliberately ephemeral (rebuild from the event
+stream; SURVEY §5) and its durable artifacts are the offloaded KV files
+— both carried over here.  What the TPU stack adds on top is model
+state: train steps (models/llama.py, models/moe.py) need durable
+params/optimizer snapshots.  Orbax handles sharded arrays natively, so
+a restore onto a different mesh layout works by passing the target
+shardings via ``abstract_target``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, force: bool = True) -> str:
+    """Persist a pytree (params / (params, opt_state) / anything jax).
+
+    ``path`` must be absolute (orbax requirement); returns it.
+    """
+    path = os.path.abspath(path)
+    checkpointer = _checkpointer()
+    checkpointer.save(path, state, force=force)
+    checkpointer.wait_until_finished()
+    return path
+
+
+def restore_checkpoint(path: str, abstract_target: Optional[Any] = None):
+    """Restore a pytree saved by ``save_checkpoint``.
+
+    ``abstract_target`` (e.g. ``jax.eval_shape`` of the state, with
+    ``jax.sharding.NamedSharding`` leaves) restores each array directly
+    onto its target device layout — the multi-chip resume path.  With
+    None, arrays land as numpy on host.
+    """
+    checkpointer = _checkpointer()
+    if abstract_target is not None:
+        return checkpointer.restore(
+            os.path.abspath(path), target=abstract_target
+        )
+    return checkpointer.restore(os.path.abspath(path))
+
+
+def abstract_like(state: Any, shardings: Optional[Any] = None):
+    """Build the ``abstract_target`` for ``restore_checkpoint``:
+    ShapeDtypeStructs of ``state``, carrying ``shardings`` if given."""
+    abstract = jax.eval_shape(lambda x: x, state)
+    if shardings is None:
+        return abstract
+    return jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract,
+        shardings,
+    )

@@ -33,6 +33,9 @@ from jax.sharding import PartitionSpec as P
 from llm_d_kv_cache_manager_tpu.ops.attention import causal_gqa_attention
 from llm_d_kv_cache_manager_tpu.ops.flash_attention import flash_gqa_attention
 from llm_d_kv_cache_manager_tpu.ops import flash_pallas
+from llm_d_kv_cache_manager_tpu.ops.paged_decode_pallas import (
+    paged_decode_attention_pallas,
+)
 from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
 
 Params = Dict[str, Any]
@@ -362,7 +365,17 @@ def decode_step(
         kv_layer = kv_layer.at[block_ids, :, slot].set(
             kv_new.astype(kv_layer.dtype)
         )
-        attn = paged_attention(q[:, 0], kv_layer, block_table, context_len)
+        # On TPU the Pallas kernel streams only the table's blocks
+        # HBM->VMEM (~2.5x the XLA gather path, which materializes the
+        # whole context); elsewhere keep the portable gather.
+        if jax.default_backend() == "tpu":
+            attn = paged_decode_attention_pallas(
+                q[:, 0], kv_layer, block_table, context_len
+            )
+        else:
+            attn = paged_attention(
+                q[:, 0], kv_layer, block_table, context_len
+            )
         x = x + jnp.einsum("bhk,hkd->bd", attn, lp["wo"])
         h2 = _rms_norm(x, lp["ln2"])[:, None]
         x = x + _mlp(h2, lp)[:, 0]

@@ -195,13 +195,33 @@ def _route(
     return dispatch, combine, aux
 
 
-def _constrain(x: jnp.ndarray, spec: P) -> jnp.ndarray:
-    """Sharding constraint that is a no-op outside a mesh context
-    (single-device tests and the unsharded serving path)."""
+def _mesh_in_context() -> bool:
+    """Whether with_sharding_constraint can resolve a PartitionSpec:
+    either a ``with mesh:`` context or a ``jax.set_mesh`` mesh."""
     try:
+        abstract = jax.sharding.get_abstract_mesh()
+        if abstract is not None and not getattr(abstract, "empty", True):
+            return True
+    except Exception:  # noqa: BLE001 - API drift across jax versions
+        pass
+    try:
+        from jax.interpreters import pxla
+
+        return not pxla.thread_resources.env.physical_mesh.empty
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _constrain(x: jnp.ndarray, spec: P) -> jnp.ndarray:
+    """Sharding constraint that is a deterministic no-op outside a mesh
+    context (single-device tests and the unsharded serving path).
+
+    The check is explicit rather than try/except: a swallowed
+    RuntimeError would silently bake a constraint-free trace into the
+    jit cache, and the expert all-to-all would never form."""
+    if _mesh_in_context():
         return lax.with_sharding_constraint(x, spec)
-    except RuntimeError:  # no mesh in context
-        return x
+    return x
 
 
 def _moe_mlp(

@@ -34,13 +34,6 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import ml_dtypes  # ships with jax; registers bfloat16 as a numpy dtype
 import numpy as np
 
-
-def host_dtype(name: str) -> np.dtype:
-    """Numpy dtype for host staging buffers, incl. bf16 via ml_dtypes."""
-    if name == "bfloat16":
-        return np.dtype(ml_dtypes.bfloat16)
-    return np.dtype(name)
-
 from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
 from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import KVCachePool
 from llm_d_kv_cache_manager_tpu.native.engine import (
@@ -51,6 +44,13 @@ from llm_d_kv_cache_manager_tpu.offload.file_mapper import FileMapper
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("offload.worker")
+
+
+def host_dtype(name: str) -> np.dtype:
+    """Numpy dtype for host staging buffers, incl. bf16 via ml_dtypes."""
+    if name == "bfloat16":
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
 
 # (file_hash, device_block_ids) — one file per offloaded block group.
 FileBlockGroup = Tuple[int, Sequence[int]]

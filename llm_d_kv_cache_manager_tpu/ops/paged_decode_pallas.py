@@ -1,0 +1,199 @@
+"""Pallas TPU paged-decode attention kernel.
+
+The XLA version (ops/paged_attention.py) gathers every table block into
+a dense [B, T, Hkv, D] tensor before attending — the whole context's
+KV crosses HBM twice (pool -> gathered copy -> compute reads).  This
+kernel is the TPU analogue of vLLM's paged-attention CUDA kernel: the
+block table rides in as a scalar-prefetch operand, each grid step's
+``index_map`` points straight at that sequence's next pool block, and
+Pallas's pipeline DMAs exactly the referenced blocks HBM->VMEM
+(double-buffered) while the MXU works on the previous one.  Past the
+context length the index map pins to the last valid block — an
+unchanged index skips the redundant DMA — and the flash accumulators
+(f32, VMEM scratch) carry the online softmax across grid steps.
+
+Contract matches ops/paged_attention.py::paged_attention; equivalence
+is pinned by tests/test_paged_decode_pallas.py (interpret mode on CPU,
+compiled on TPU via bench paths).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# Pool blocks fetched per grid step: amortizes per-step pipeline
+# overhead (528 one-block steps left the MXU mostly idle) while each
+# block still arrives through its own independently-pipelined DMA.
+BLOCKS_PER_STEP = 4
+
+
+def _decode_kernel(
+    table_ref,  # SMEM [B, max_blocks] int32 (scalar prefetch)
+    ctx_ref,  # SMEM [B] int32 (scalar prefetch)
+    q_ref,  # VMEM [1, H, D]
+    *rest,  # BLOCKS_PER_STEP kv refs, out ref, then scratch
+    block_size: int,
+    groups: int,
+    scale: float,
+):
+    kv_refs = rest[:BLOCKS_PER_STEP]
+    out_ref = rest[BLOCKS_PER_STEP]
+    m_ref, l_ref, acc_ref = rest[BLOCKS_PER_STEP + 1 :]
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    n_steps = pl.num_programs(1)
+    ctx = ctx_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    H = q_ref.shape[1]
+    D = q_ref.shape[2]
+    Hkv = kv_refs[0].shape[3]
+    q = q_ref[0].astype(jnp.float32) * scale  # [H, D]
+    qb = q.reshape(Hkv, groups, D)
+
+    for i, kv_ref in enumerate(kv_refs):
+        # Valid positions in sub-block i: [(j*P+i)*bs, ctx).
+        valid = ctx - (j * BLOCKS_PER_STEP + i) * block_size
+
+        @pl.when(valid > 0)
+        def _attend(kv_ref=kv_ref, valid=valid):
+            k = kv_ref[0, 0].astype(jnp.float32)  # [bs, Hkv, D]
+            v = kv_ref[0, 1].astype(jnp.float32)
+            kb = k.transpose(1, 0, 2)  # [Hkv, bs, D]
+            vb = v.transpose(1, 0, 2)
+            s = jax.lax.dot_general(
+                qb,
+                kb,
+                (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )  # [Hkv, G, bs]
+            s = s.reshape(H, block_size)
+            col = jax.lax.broadcasted_iota(
+                jnp.int32, (H, block_size), 1
+            )
+            s = jnp.where(col < valid, s, NEG_INF)
+
+            m_prev = m_ref[:, :1]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(s, axis=1, keepdims=True)
+            )
+            p = jnp.exp(s - m_new)  # [H, bs]
+            correction = jnp.exp(m_prev - m_new)
+            l_ref[...] = l_ref[...] * correction + jnp.sum(
+                p, axis=1, keepdims=True
+            )
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            pb = p.reshape(Hkv, groups, block_size)
+            o = jax.lax.dot_general(
+                pb,
+                vb,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )  # [Hkv, G, D]
+            acc_ref[...] = acc_ref[...] * correction + o.reshape(H, D)
+
+    @pl.when(j == n_steps - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        out = acc_ref[...] / jnp.where(l == 0.0, 1.0, l)
+        out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,
+    kv_layer: jnp.ndarray,
+    block_table: jnp.ndarray,
+    context_len: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """q: [B, H, D]; kv_layer: [num_blocks, 2, bs, Hkv, D];
+    block_table: [B, max_blocks] int32; context_len: [B] int32.
+    Returns [B, H, D] in q.dtype."""
+    B, H, D = q.shape
+    _, _, block_size, Hkv, _ = kv_layer.shape
+    groups = H // Hkv
+    max_blocks = block_table.shape[1]
+    P_STEP = BLOCKS_PER_STEP
+    n_steps = -(-max_blocks // P_STEP)
+    if max_blocks % P_STEP:
+        # Pad table columns; pads resolve to the last valid block and
+        # are masked by context_len in the kernel.
+        block_table = jnp.pad(
+            block_table,
+            ((0, 0), (0, n_steps * P_STEP - max_blocks)),
+        )
+
+    def kv_index(i):
+        # Sub-block i of step j; past-context steps revisit the last
+        # valid block (an unchanged index skips the DMA).
+        def index(b, j, table_ref, ctx_ref):
+            jc = jnp.minimum(
+                j * P_STEP + i,
+                jnp.maximum((ctx_ref[b] - 1) // block_size, 0),
+            )
+            return (table_ref[b, jc], 0, 0, 0, 0)
+
+        return index
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_steps),
+        in_specs=[
+            pl.BlockSpec(
+                (1, H, D),
+                lambda b, j, *_: (b, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+        + [
+            pl.BlockSpec(
+                (1, 2, block_size, Hkv, D),
+                kv_index(i),
+                memory_space=pltpu.VMEM,
+            )
+            for i in range(P_STEP)
+        ],
+        out_specs=pl.BlockSpec(
+            (1, H, D),
+            lambda b, j, *_: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel,
+        block_size=block_size,
+        groups=groups,
+        scale=D**-0.5,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(
+        block_table.astype(jnp.int32),
+        context_len.astype(jnp.int32),
+        q,
+        *([kv_layer] * BLOCKS_PER_STEP),
+    )

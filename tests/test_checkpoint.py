@@ -1,0 +1,81 @@
+"""Checkpoint/resume: round trip, sharded restore, resume-training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from llm_d_kv_cache_manager_tpu.models import checkpoint, llama
+from llm_d_kv_cache_manager_tpu.parallel.mesh import MeshPlan, make_mesh
+
+CFG = llama.LlamaConfig(
+    vocab_size=256,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=128,
+)
+
+
+def test_round_trip(tmp_path):
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    path = checkpoint.save_checkpoint(str(tmp_path / "ckpt"), params)
+    restored = checkpoint.restore_checkpoint(path)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        params,
+        restored,
+    )
+
+
+def test_sharded_restore_onto_mesh(tmp_path):
+    """Save unsharded, restore directly onto a tp=2 x dp=4 mesh — the
+    multi-chip resume path."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    path = checkpoint.save_checkpoint(str(tmp_path / "ckpt"), params)
+
+    mesh = make_mesh(MeshPlan(dp=4, tp=2), jax.devices()[:8])
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        llama.param_pspecs(CFG),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    target = checkpoint.abstract_like(params, shardings)
+    restored = checkpoint.restore_checkpoint(path, target)
+
+    embed = restored["embed"]
+    assert embed.sharding == shardings["embed"]
+    np.testing.assert_array_equal(
+        np.asarray(embed), np.asarray(params["embed"])
+    )
+
+
+def test_resume_training_continues(tmp_path):
+    """Loss after save/restore matches an uninterrupted run bit-for-bit."""
+    optimizer = llama.make_optimizer()
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    opt_state = optimizer.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 256)
+
+    step = jax.jit(
+        lambda p, o, t: llama.train_step(p, o, t, CFG, optimizer)
+    )
+    params, opt_state, _ = step(params, opt_state, tokens)
+    path = checkpoint.save_checkpoint(
+        str(tmp_path / "ckpt"), {"params": params, "opt": opt_state}
+    )
+    params, opt_state, loss_straight = step(params, opt_state, tokens)
+
+    # The optimizer state is a pytree of NamedTuples; restoring against
+    # an abstract target preserves that structure (a bare restore
+    # returns plain dicts).
+    target = checkpoint.abstract_like(
+        {"params": params, "opt": opt_state}
+    )
+    state = checkpoint.restore_checkpoint(path, target)
+    _, _, loss_resumed = step(state["params"], state["opt"], tokens)
+    assert float(loss_straight) == float(loss_resumed)
