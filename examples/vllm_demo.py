@@ -1,0 +1,116 @@
+"""Live-engine demo: index KV events from a real vLLM(-TPU) process.
+
+Counterpart of the reference's real-engine demo
+(examples/kv_events/vllm/vllm_kv_cache_demo.py): boot vLLM with KV
+events enabled, subscribe the indexer to its ZMQ stream, run prompts,
+and watch pod scores reflect the engine's actual prefix cache.
+
+Requires a vLLM install (vllm-tpu on TPU VMs); in environments without
+it this prints the integration recipe and exits cleanly so
+hack/verify-examples.sh can include it unconditionally.
+
+Fleet invariants (docs/configuration.md):
+- engine `--block-size` must equal the indexer's block_size
+- engine PYTHONHASHSEED must equal the indexer's hash_seed
+- `prefix_caching_hash_algo="sha256_cbor"` interops via the
+  engineKey->requestKey map (last-8-bytes big-endian rule)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+MODEL = os.environ.get("MODEL_NAME", "meta-llama/Llama-3.1-8B-Instruct")
+BLOCK_SIZE = int(os.environ.get("BLOCK_SIZE", "16"))
+ZMQ_ENDPOINT = os.environ.get("ZMQ_ENDPOINT", "tcp://localhost:5557")
+POD = os.environ.get("POD_IDENTIFIER", "localhost")
+
+RECIPE = f"""\
+vLLM not installed — to run this demo on a serving host:
+
+  PYTHONHASHSEED=42 vllm serve {MODEL} \\
+    --block-size {BLOCK_SIZE} \\
+    --kv-events-config '{{
+        "enable_kv_cache_events": true,
+        "publisher": "zmq",
+        "endpoint": "{ZMQ_ENDPOINT.replace("localhost", "*")}",
+        "topic": "kv@{POD}@{MODEL}"
+      }}' \\
+    --prefix-caching-hash-algo sha256_cbor
+
+then:  python examples/vllm_demo.py
+
+vllm demo completed successfully (recipe mode)\
+"""
+
+
+def main() -> None:
+    try:
+        import vllm  # noqa: F401
+    except ImportError:
+        print(RECIPE)
+        return
+
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+        Indexer,
+        IndexerConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.pool import Pool, PoolConfig
+    from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
+        SubscriberManager,
+    )
+    from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+        TokenizationPoolConfig,
+    )
+
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE,
+                hash_seed=os.environ.get("PYTHONHASHSEED", ""),
+            ),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                model_name=MODEL
+            ),
+        )
+    )
+    indexer.run()
+    pool = Pool(
+        indexer.kv_block_index,
+        indexer.token_processor,
+        PoolConfig(concurrency=2),
+    )
+    pool.start()
+    manager = SubscriberManager(sink=pool.add_task)
+    manager.ensure_subscriber(POD, ZMQ_ENDPOINT)
+
+    from vllm import LLM, SamplingParams
+
+    llm = LLM(
+        model=MODEL,
+        enable_prefix_caching=True,
+        block_size=BLOCK_SIZE,
+    )
+    shared = "You are a helpful assistant. " * 200
+    prompts = [shared + q for q in ("What is JAX?", "What is a TPU?")]
+    llm.generate(prompts, SamplingParams(max_tokens=8))
+    time.sleep(2.0)  # let events drain
+
+    for prompt in prompts:
+        scores = indexer.get_pod_scores(prompt, MODEL, None)
+        print(f"scores for {prompt[-24:]!r}: {scores}")
+        assert scores.get(POD, 0) > 0, "engine events not indexed"
+
+    manager.shutdown()
+    pool.shutdown()
+    indexer.shutdown()
+    print("vllm demo completed successfully")
+
+
+if __name__ == "__main__":
+    main()

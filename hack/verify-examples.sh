@@ -7,7 +7,7 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-for demo in offline_demo index_service_demo online_demo valkey_demo; do
+for demo in offline_demo index_service_demo online_demo valkey_demo vllm_demo; do
   echo "=== examples/${demo}.py ==="
   python "examples/${demo}.py" 2>&1 | grep "completed successfully" \
     || { echo "FAIL: ${demo}"; exit 1; }

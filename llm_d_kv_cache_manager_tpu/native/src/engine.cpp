@@ -10,6 +10,7 @@
 
 #include <cstring>
 
+#include "debug_utils.hpp"
 #include "kvtpu_native.hpp"
 
 namespace kvtpu {
@@ -59,7 +60,10 @@ void OffloadEngine::store(int64_t job_id,
                 file_size(path) >= static_cast<int64_t>(size) &&
                 touch_file(path);
       if (!ok) {
-        ok = write_buffer_to_file(path, buffer, size);
+        KVTPU_TIME_EXPR("store:write_file",
+                        ok = write_buffer_to_file(path, buffer, size));
+      } else {
+        KVTPU_DEBUG_PRINT("store:skip_existing %s", path.c_str());
       }
       finish_task(job_id, job, ok);
     });
@@ -80,7 +84,10 @@ void OffloadEngine::load(int64_t job_id,
     uint8_t* buffer = buffers[i];
     const size_t size = sizes[i];
     pool_.enqueue([this, job_id, job, path, buffer, size] {
-      finish_task(job_id, job, read_buffer_from_file(path, buffer, size));
+      bool ok = false;
+      KVTPU_TIME_EXPR("load:read_file",
+                      ok = read_buffer_from_file(path, buffer, size));
+      finish_task(job_id, job, ok);
     });
   }
 }
