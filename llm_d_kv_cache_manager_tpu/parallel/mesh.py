@@ -94,3 +94,48 @@ def make_mesh(
     shape = tuple(sizes[a] for a in AXIS_ORDER)
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
+
+
+def make_hybrid_mesh(
+    ici_plan: Optional[MeshPlan] = None,
+    dcn_plan: Optional[MeshPlan] = None,
+) -> Mesh:
+    """Multi-host mesh: per-axis ICI (intra-slice) x DCN (cross-host)
+    degrees, same canonical axis names.
+
+    The fleet-scaling recipe: call ``jax.distributed.initialize()`` on
+    every host of a multi-slice/multi-host deployment, then build the
+    mesh here.  ``mesh_utils.create_hybrid_device_mesh`` orders devices
+    so each axis's DCN factor crosses slice boundaries while its ICI
+    factor stays inside a slice — collectives for ``tp``/``sp`` ride
+    ICI, while ``dp`` (gradient all-reduce, the bandwidth-tolerant one)
+    crosses DCN, mirroring how the reference's fleet keeps NCCL
+    intra-pod and scales pods over the datacenter network.
+
+    ``dcn_plan`` defaults to data-parallel over the process count
+    (dp=n_processes) — the standard multi-host serving/training fleet.
+    """
+    from jax.experimental import mesh_utils
+
+    devices = jax.devices()
+    n_processes = max(d.process_index for d in devices) + 1
+    per_slice = len(devices) // n_processes
+    ici_plan = ici_plan or MeshPlan(dp=1, tp=per_slice)
+    dcn_plan = dcn_plan or MeshPlan(dp=n_processes)
+    ici_sizes = ici_plan.resolve(per_slice)
+    dcn_sizes = dcn_plan.resolve(n_processes)
+    if n_processes == 1:
+        # Single host: hybrid degenerates to the flat ICI mesh.
+        merged = MeshPlan(
+            **{
+                a: ici_sizes[a] * dcn_sizes[a]
+                for a in (AXIS_DP, AXIS_PP, AXIS_TP, AXIS_SP, AXIS_EP)
+            }
+        )
+        return make_mesh(merged, devices)
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=tuple(ici_sizes[a] for a in AXIS_ORDER),
+        dcn_mesh_shape=tuple(dcn_sizes[a] for a in AXIS_ORDER),
+        devices=devices,
+    )
+    return Mesh(dev_array, AXIS_ORDER)

@@ -1,0 +1,71 @@
+"""Mesh plans: resolution rules, canonical order, hybrid construction."""
+
+import jax
+import numpy as np
+import pytest
+
+from llm_d_kv_cache_manager_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshPlan,
+    make_hybrid_mesh,
+    make_mesh,
+)
+
+
+class TestMeshPlan:
+    def test_free_axis_absorbs_remainder(self):
+        sizes = MeshPlan(dp=-1, tp=2, sp=2).resolve(8)
+        assert sizes["dp"] == 2 and sizes["tp"] == 2 and sizes["sp"] == 2
+
+    def test_exact_product_required_without_free_axis(self):
+        assert MeshPlan(dp=2, tp=4).resolve(8)["tp"] == 4
+        with pytest.raises(ValueError):
+            MeshPlan(dp=2, tp=3).resolve(8)
+
+    def test_indivisible_rejected(self):
+        with pytest.raises(ValueError):
+            MeshPlan(dp=-1, tp=3).resolve(8)
+
+    def test_two_free_axes_rejected(self):
+        with pytest.raises(ValueError):
+            MeshPlan(dp=-1, tp=-1).resolve(8)
+
+
+class TestMakeMesh:
+    def test_canonical_axis_order(self):
+        mesh = make_mesh(MeshPlan(dp=2, tp=2, sp=2), jax.devices()[:8])
+        assert mesh.axis_names == AXIS_ORDER
+        assert mesh.shape["dp"] == 2
+        assert mesh.shape["tp"] == 2
+
+    def test_collective_runs_on_mesh(self):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh(MeshPlan(dp=4, tp=2), jax.devices()[:8])
+        x = jax.device_put(
+            jnp.arange(16.0).reshape(8, 2),
+            NamedSharding(mesh, P("dp", "tp")),
+        )
+        total = jax.jit(jnp.sum)(x)
+        assert float(total) == float(np.arange(16.0).sum())
+
+
+class TestHybridMesh:
+    def test_single_process_degenerates_to_flat(self):
+        """On one host the hybrid mesh merges ici x dcn degrees per
+        axis (real multi-host needs jax.distributed.initialize)."""
+        mesh = make_hybrid_mesh(
+            ici_plan=MeshPlan(dp=1, tp=4, sp=2),
+            dcn_plan=MeshPlan(dp=1),
+        )
+        assert mesh.shape["tp"] == 4
+        assert mesh.shape["sp"] == 2
+        assert mesh.axis_names == AXIS_ORDER
+
+    def test_defaults_use_all_devices(self):
+        mesh = make_hybrid_mesh()
+        assert int(np.prod(list(mesh.shape.values()))) == len(
+            jax.devices()
+        )
