@@ -42,6 +42,9 @@ class TPUOffloadSpec:
     offloaded_block_size: int = 64
     threads_per_chip: int = 4
     numa_node: int = -1
+    # Host-DRAM tier budget; 0 disables the middle tier and offload
+    # goes straight to shared storage (docs/architecture.md ladder).
+    host_cache_bytes: int = 0
     dtype: str = "bfloat16"
     tp_size: int = 1
     pp_size: int = 1
@@ -109,11 +112,22 @@ class TPUOffloadConnector:
         self.engine = OffloadEngine(
             n_threads=spec.threads_per_chip, numa_node=spec.numa_node
         )
+        self.host_cache = None
+        if spec.host_cache_bytes > 0:
+            from llm_d_kv_cache_manager_tpu.offload.host_tier import (
+                HostTierCache,
+            )
+
+            self.host_cache = HostTierCache(spec.host_cache_bytes)
         self.store_handler = DeviceToStorageHandler(
-            pool, self.engine, self.file_mapper, event_sink=event_sink
+            pool,
+            self.engine,
+            self.file_mapper,
+            event_sink=event_sink,
+            host_cache=self.host_cache,
         )
         self.load_handler = StorageToDeviceHandler(
-            pool, self.engine, self.file_mapper
+            pool, self.engine, self.file_mapper, host_cache=self.host_cache
         )
 
     def get_manager(self) -> SharedStorageOffloadManager:

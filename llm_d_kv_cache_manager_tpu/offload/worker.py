@@ -60,6 +60,7 @@ FileBlockGroup = Tuple[int, Sequence[int]]
 StoreEventSink = Callable[[List[int], str], None]
 
 SHARED_STORAGE_MEDIUM = "shared_storage"
+HOST_MEDIUM = "host"
 
 
 def group_blocks_per_file(
@@ -128,11 +129,22 @@ class _HandlerBase:
 
 
 class DeviceToStorageHandler(_HandlerBase):
-    """Asynchronously persist device blocks to shared storage."""
+    """Asynchronously persist device blocks to shared storage.
 
-    def __init__(self, *args, event_sink: Optional[StoreEventSink] = None):
+    With a ``host_cache``, gathered groups also stay resident in host
+    DRAM (the middle tier) and a ``host``-medium event fires
+    immediately — the durable ``shared_storage`` event follows when the
+    file write lands."""
+
+    def __init__(
+        self,
+        *args,
+        event_sink: Optional[StoreEventSink] = None,
+        host_cache=None,
+    ):
         super().__init__(*args)
         self._event_sink = event_sink
+        self._host_cache = host_cache
         # job_id -> (file hashes, payload bytes) until completion.
         self._job_hashes: Dict[int, Tuple[List[int], int]] = {}
 
@@ -155,6 +167,15 @@ class DeviceToStorageHandler(_HandlerBase):
             # docstring: head-of-file == first blocks).
             buffers.append(np.ascontiguousarray(np.moveaxis(chunk, 1, 0)))
             cursor += len(ids)
+        if self._host_cache is not None:
+            admitted = [
+                file_hash
+                for (file_hash, _), buffer in zip(groups, buffers)
+                if self._host_cache.put(file_hash, buffer)
+            ]
+            # Advertise only what the budget actually admitted.
+            if admitted and self._event_sink is not None:
+                self._event_sink(admitted, HOST_MEDIUM)
         self._job_hashes[job_id] = (
             [h for h, _ in groups],
             sum(buffer.nbytes for buffer in buffers),
@@ -178,10 +199,14 @@ class DeviceToStorageHandler(_HandlerBase):
 
 
 class StorageToDeviceHandler(_HandlerBase):
-    """Asynchronously page blocks from shared storage into the pool."""
+    """Asynchronously page blocks from shared storage into the pool.
 
-    def __init__(self, *args):
+    With a ``host_cache``, resident groups are served from host DRAM
+    (memcpy, no file I/O); only the cache misses go to the engine."""
+
+    def __init__(self, *args, host_cache=None):
         super().__init__(*args)
+        self._host_cache = host_cache
         # job_id -> (device_block_ids, host buffers awaiting scatter)
         self._pending: Dict[int, Tuple[List[int], List[np.ndarray]]] = {}
 
@@ -191,13 +216,20 @@ class StorageToDeviceHandler(_HandlerBase):
         c = self.pool.config
         paths: List[str] = []
         buffers: List[np.ndarray] = []
+        file_buffers: List[np.ndarray] = []
         all_ids: List[int] = []
         for file_hash, ids in groups:
-            paths.append(self.file_mapper.get_file_name(file_hash))
-            # Block-major to match the file bytes; transposed back to the
-            # pool's layer-major layout at scatter time.
-            buffers.append(
-                np.empty(
+            cached = (
+                self._host_cache.get(file_hash)
+                if self._host_cache is not None
+                else None
+            )
+            if cached is not None and cached.shape[0] >= len(ids):
+                # Host-tier hit: a partial request reads the group's
+                # head blocks (block-major layout invariant).
+                buffers.append(cached[: len(ids)])
+            else:
+                buffer = np.empty(
                     (
                         len(ids),
                         c.num_layers,
@@ -208,10 +240,13 @@ class StorageToDeviceHandler(_HandlerBase):
                     ),
                     dtype=host_dtype(c.dtype),
                 )
-            )
+                buffers.append(buffer)
+                file_buffers.append(buffer)
+                paths.append(self.file_mapper.get_file_name(file_hash))
             all_ids.extend(ids)
         self._pending[job_id] = (all_ids, buffers)
-        self.engine.load(job_id, paths, buffers)
+        # Zero-file jobs still register so get_finished reports them.
+        self.engine.load(job_id, paths, file_buffers)
 
     def owns(self, job_id: int) -> bool:
         return job_id in self._pending

@@ -133,9 +133,14 @@ def make_hybrid_mesh(
             }
         )
         return make_mesh(merged, devices)
+    # Granule = process: dcn degrees count hosts, matching this
+    # function's contract on every backend (jax's default granule is
+    # the TPU slice, which breaks single-slice multi-host deployments
+    # and CPU clusters whose devices have no slice_index).
     dev_array = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=tuple(ici_sizes[a] for a in AXIS_ORDER),
         dcn_mesh_shape=tuple(dcn_sizes[a] for a in AXIS_ORDER),
         devices=devices,
+        process_is_granule=True,
     )
     return Mesh(dev_array, AXIS_ORDER)

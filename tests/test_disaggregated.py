@@ -1,0 +1,139 @@
+"""Disaggregated prefill/decode: cross-pod KV transfer via the connector.
+
+BASELINE.json config #5: a prefill pod computes a prompt's KV and
+persists it through the offload connector; a separate decode pod, with
+its own independent block pool, discovers the prefix in shared storage
+(manager lookup), pages it in, and continues decoding — producing
+exactly the logits the prefill pod would have.  The shared-storage file
+layout is pod-independent (model/geometry/mesh/rank/dtype only), which
+is what makes the transfer medium work across pods, mirroring the
+reference's cross-pod shared-filesystem design (manager.py:44-54).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_d_kv_cache_manager_tpu.models import llama
+from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import (
+    KVCachePool,
+    KVCachePoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.native.engine import JobStatus
+from llm_d_kv_cache_manager_tpu.offload.spec import (
+    TPUOffloadConnector,
+    TPUOffloadSpec,
+)
+from llm_d_kv_cache_manager_tpu.offload.worker import group_blocks_per_file
+
+CFG = llama.LlamaConfig(
+    vocab_size=512,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=128,
+    block_size=8,
+)
+PROMPT_TOKENS = 32  # 4 device blocks
+POOL = KVCachePoolConfig(
+    num_layers=CFG.n_layers,
+    num_blocks=16,
+    block_size=CFG.block_size,
+    num_kv_heads=CFG.n_kv_heads,
+    head_dim=CFG.head_dim,
+    dtype="bfloat16",
+)
+
+
+def make_connector(tmp_path, pool):
+    return TPUOffloadConnector(
+        TPUOffloadSpec(
+            shared_storage_path=str(tmp_path),
+            model_name="test/llama",
+            device_block_size=CFG.block_size,
+            offloaded_block_size=CFG.block_size * 2,
+            threads_per_chip=2,
+        ),
+        pool,
+    )
+
+
+def test_prefill_pod_to_decode_pod(tmp_path):
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (1, PROMPT_TOKENS), 0, CFG.vocab_size
+    )
+    n_blocks = PROMPT_TOKENS // CFG.block_size
+    file_hashes = [0x9A00 + i for i in range(n_blocks // 2)]
+
+    # --- prefill pod: compute KV, persist through its connector ---
+    prefill_pool = KVCachePool(POOL)
+    prefill_conn = make_connector(tmp_path, prefill_pool)
+    prefill_ids = list(range(n_blocks))
+    logits_prefill, prefill_pool.kv = llama.prefill_paged(
+        params,
+        tokens,
+        prefill_pool.kv,
+        jnp.asarray([prefill_ids], jnp.int32),
+        CFG,
+    )
+    groups = group_blocks_per_file(
+        file_hashes, prefill_ids, prefill_conn.spec.blocks_per_file
+    )
+    prefill_conn.store_handler.transfer_async(1, groups)
+    assert prefill_conn.store_handler.wait(1) == JobStatus.SUCCEEDED
+
+    # --- decode pod: discover, page in, continue ---
+    decode_pool = KVCachePool(POOL)
+    decode_conn = make_connector(tmp_path, decode_pool)
+    # Scheduler-side lookup: how many consecutive offloaded blocks exist?
+    assert decode_conn.get_manager().lookup(file_hashes) == len(file_hashes)
+
+    decode_ids = [7, 3, 11, 5]  # deliberately different pool layout
+    decode_groups = group_blocks_per_file(
+        file_hashes, decode_ids, decode_conn.spec.blocks_per_file
+    )
+    decode_conn.load_handler.transfer_async(2, decode_groups)
+    assert decode_conn.load_handler.wait(2) == JobStatus.SUCCEEDED
+
+    # Decode the next token on each pod; logits must agree exactly.
+    next_token = jnp.argmax(logits_prefill[:, -1], axis=-1).astype(
+        jnp.int32
+    )
+    max_blocks = n_blocks + 1
+    ctx = jnp.asarray([PROMPT_TOKENS + 1], jnp.int32)
+
+    logits_a, _ = llama.decode_step(
+        params,
+        next_token,
+        prefill_pool.kv,
+        jnp.asarray([prefill_ids + [8]], jnp.int32),
+        ctx,
+        CFG,
+    )
+    logits_b, _ = llama.decode_step(
+        params,
+        next_token,
+        decode_pool.kv,
+        jnp.asarray([decode_ids + [0]], jnp.int32),
+        ctx,
+        CFG,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(logits_a), np.asarray(logits_b)
+    )
+
+
+def test_decode_pod_partial_prefix_detected(tmp_path):
+    """A partially-transferred prefix is reported as the consecutive
+    head only — the decode pod prefills the tail itself."""
+    pool = KVCachePool(POOL)
+    conn = make_connector(tmp_path, pool)
+    hashes = [0x9B00 + i for i in range(3)]
+    groups = group_blocks_per_file(
+        hashes[:2], list(range(4)), conn.spec.blocks_per_file
+    )
+    conn.store_handler.transfer_async(1, groups)
+    assert conn.store_handler.wait(1) == JobStatus.SUCCEEDED
+    assert conn.get_manager().lookup(hashes) == 2

@@ -1,0 +1,153 @@
+"""Host-DRAM tier: LRU budget, load fast path, tiered events."""
+
+import os
+
+import numpy as np
+import pytest
+
+from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import (
+    KVCachePool,
+    KVCachePoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.native.engine import JobStatus
+from llm_d_kv_cache_manager_tpu.offload.host_tier import HostTierCache
+from llm_d_kv_cache_manager_tpu.offload.spec import (
+    TPUOffloadConnector,
+    TPUOffloadSpec,
+)
+from llm_d_kv_cache_manager_tpu.offload.worker import (
+    group_blocks_per_file,
+    host_dtype,
+)
+
+POOL = KVCachePoolConfig(
+    num_layers=2,
+    num_blocks=16,
+    block_size=4,
+    num_kv_heads=2,
+    head_dim=8,
+    dtype="bfloat16",
+)
+
+
+class TestHostTierCache:
+    def test_put_get_refresh(self):
+        cache = HostTierCache(max_bytes=1 << 20)
+        group = np.ones((2, 8), np.uint8)
+        cache.put(1, group)
+        assert cache.get(1) is group
+        assert cache.get(2) is None
+        assert cache.stats()["hits"] == 1
+        assert cache.stats()["misses"] == 1
+
+    def test_budget_evicts_lru(self):
+        cache = HostTierCache(max_bytes=100)
+        a, b, c = (np.zeros(40, np.uint8) for _ in range(3))
+        cache.put(1, a)
+        cache.put(2, b)
+        cache.get(1)  # refresh 1; 2 becomes LRU
+        cache.put(3, c)
+        assert cache.get(2) is None
+        assert cache.get(1) is not None
+        assert cache.get(3) is not None
+        assert cache.resident_bytes <= 100
+
+    def test_oversized_group_not_admitted(self):
+        cache = HostTierCache(max_bytes=10)
+        cache.put(1, np.zeros(100, np.uint8))
+        assert cache.get(1) is None
+
+    def test_lookup_consecutive(self):
+        cache = HostTierCache()
+        for h in (1, 2, 4):
+            cache.put(h, np.zeros(4, np.uint8))
+        assert cache.lookup_consecutive([1, 2, 3, 4]) == 2
+        assert cache.lookup_consecutive([5]) == 0
+
+
+def make_connector(tmp_path, pool, host_cache_bytes, events=None):
+    return TPUOffloadConnector(
+        TPUOffloadSpec(
+            shared_storage_path=str(tmp_path),
+            model_name="test/host-tier",
+            device_block_size=POOL.block_size,
+            offloaded_block_size=POOL.block_size * 2,
+            threads_per_chip=2,
+            host_cache_bytes=host_cache_bytes,
+        ),
+        pool,
+        event_sink=(
+            (lambda h, m: events.append((list(h), m)))
+            if events is not None
+            else None
+        ),
+    )
+
+
+class TestTieredOffload:
+    def test_load_served_from_host_tier_without_files(self, tmp_path):
+        """After a store, a load must succeed even if the shared-storage
+        files are deleted — the group is host-resident."""
+        pool = KVCachePool(POOL)
+        events = []
+        conn = make_connector(tmp_path, pool, 64 << 20, events)
+        rng = np.random.default_rng(0)
+        n = 4
+        ref = rng.standard_normal(
+            (POOL.num_layers, n, 2, POOL.block_size, POOL.num_kv_heads,
+             POOL.head_dim)
+        ).astype(host_dtype(POOL.dtype))
+        pool.scatter_from_host(list(range(n)), ref)
+
+        hashes = [0x11, 0x22]
+        groups = group_blocks_per_file(hashes, list(range(n)), 2)
+        conn.store_handler.transfer_async(1, groups)
+        assert conn.store_handler.wait(1) == JobStatus.SUCCEEDED
+        # Tiered events: host immediately, shared_storage on landing.
+        assert (hashes, "host") in events
+        assert (hashes, "shared_storage") in events
+
+        # Remove the durable copies; wipe the pool; reload.
+        for h in hashes:
+            os.unlink(conn.file_mapper.get_file_name(h))
+        pool.scatter_from_host(list(range(n)), np.zeros_like(ref))
+        load_groups = group_blocks_per_file(hashes, [9, 8, 7, 6], 2)
+        conn.load_handler.transfer_async(2, load_groups)
+        assert conn.load_handler.wait(2) == JobStatus.SUCCEEDED
+        back = pool.gather_to_host([9, 8, 7, 6])
+        np.testing.assert_array_equal(
+            np.asarray(back, np.float32), np.asarray(ref, np.float32)
+        )
+        assert conn.host_cache.stats()["hits"] == 2
+
+    def test_miss_falls_back_to_files(self, tmp_path):
+        pool = KVCachePool(POOL)
+        conn = make_connector(tmp_path, pool, 64 << 20)
+        rng = np.random.default_rng(1)
+        ref = rng.standard_normal(
+            (POOL.num_layers, 2, 2, POOL.block_size, POOL.num_kv_heads,
+             POOL.head_dim)
+        ).astype(host_dtype(POOL.dtype))
+        pool.scatter_from_host([0, 1], ref)
+        groups = group_blocks_per_file([0x33], [0, 1], 2)
+        conn.store_handler.transfer_async(1, groups)
+        assert conn.store_handler.wait(1) == JobStatus.SUCCEEDED
+
+        conn.host_cache.evict(0x33)  # force the file path
+        pool.scatter_from_host([0, 1], np.zeros_like(ref))
+        conn.load_handler.transfer_async(2, groups)
+        assert conn.load_handler.wait(2) == JobStatus.SUCCEEDED
+        back = pool.gather_to_host([0, 1])
+        np.testing.assert_array_equal(
+            np.asarray(back, np.float32), np.asarray(ref, np.float32)
+        )
+
+    def test_disabled_tier_unchanged_behavior(self, tmp_path):
+        pool = KVCachePool(POOL)
+        events = []
+        conn = make_connector(tmp_path, pool, 0, events)
+        assert conn.host_cache is None
+        groups = group_blocks_per_file([0x44], [0, 1], 2)
+        conn.store_handler.transfer_async(1, groups)
+        assert conn.store_handler.wait(1) == JobStatus.SUCCEEDED
+        assert [m for _, m in events] == ["shared_storage"]
