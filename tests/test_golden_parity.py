@@ -1,0 +1,247 @@
+"""Golden cross-implementation hash parity.
+
+The four hashes below were precomputed by the *reference* Go indexer
+(examples/testdata/data.go:32-37) for the Lorem-Ipsum prompt in
+tests/testdata/golden/prompt.txt, tokenized with bert-base-uncased
+(the tokenizer fixture checked into the reference e2e suite), chunked
+into 256-token blocks and hashed with the chained canonical-CBOR +
+FNV-64a pipeline.  Reproducing them here proves bit-equality of the
+entire contract — tokenizer, special-token policy, chunking, CBOR
+canonical form, FNV chain — with the reference implementation, closing
+the "an agreeing bug in reading the algorithm would pass" gap that
+self-derived vectors leave open.
+
+A second set of tests verifies the canonical-CBOR encoder against an
+independent, spec-written decoder (RFC 8949), so encoder bugs can't
+hide behind their own output.
+"""
+
+import os
+import struct
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cbor_canonical import (
+    encode_canonical,
+    encode_hash_payload,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    IndexConfig,
+    InMemoryIndexConfig,
+    PodEntry,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+    LocalFastTokenizer,
+)
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
+PROMPT_PATH = os.path.join(TESTDATA, "golden", "prompt.txt")
+TOKENIZERS_DIR = os.path.join(TESTDATA, "tokenizers")
+
+MODEL = "bert-base-uncased"
+BLOCK_SIZE = 256  # reference examples/kv_cache_index/main.go
+
+# Reference examples/testdata/data.go:32-37 (PromptHashes).
+GOLDEN_HASHES = [
+    3246512376769953277,
+    2932514196368075983,
+    6384763183060574933,
+    13975137892230421288,
+]
+# The prompt is 1309 tokens incl. [CLS]/[SEP]: 5 full 256-token blocks,
+# so the golden values pin the first 4 links of a 5-link chain.
+GOLDEN_TOKEN_COUNT = 1309
+
+
+def load_prompt() -> str:
+    with open(PROMPT_PATH, encoding="utf-8") as f:
+        return f.read()
+
+
+def tokenize_prompt() -> list:
+    tokenizer = LocalFastTokenizer(TOKENIZERS_DIR)
+    return tokenizer.encode(load_prompt(), MODEL, True).tokens
+
+
+class TestGoldenChain:
+    def test_tokenizer_fixture_reproduces_reference_tokens(self):
+        tokens = tokenize_prompt()
+        assert len(tokens) == GOLDEN_TOKEN_COUNT
+        # bert special-token framing, as the reference's non-chat path
+        # encodes (addSpecialToken=true).
+        assert tokens[0] == 101  # [CLS]
+        assert tokens[-1] == 102  # [SEP]
+
+    def test_chain_reproduces_reference_prompt_hashes(self):
+        tokens = tokenize_prompt()
+        db = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=BLOCK_SIZE, hash_seed=""),
+            use_native=False,
+        )
+        keys = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, MODEL)
+        assert keys[: len(GOLDEN_HASHES)] == GOLDEN_HASHES
+
+    def test_native_chain_matches_golden(self):
+        db = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=BLOCK_SIZE, hash_seed=""),
+            use_native=True,
+        )
+        if db._native_chain is None:
+            pytest.skip("native engine unavailable")
+        tokens = tokenize_prompt()
+        keys = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, MODEL)
+        assert keys[: len(GOLDEN_HASHES)] == GOLDEN_HASHES
+
+    def test_indexer_read_path_scores_golden_blocks(self):
+        """Mirror reference examples/kv_cache_index/main.go: seed the index
+        with the golden hashes as engine==request keys for one pod, then
+        score the golden prompt through the full read path."""
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=BLOCK_SIZE, hash_seed=""
+                ),
+                kvblock_index_config=IndexConfig(
+                    in_memory_config=InMemoryIndexConfig(size=10_000)
+                ),
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    workers=2, model_name=MODEL
+                ),
+            ),
+            tokenizer=LocalFastTokenizer(TOKENIZERS_DIR),
+        )
+        indexer.run()
+        try:
+            prompt = load_prompt()
+            assert indexer.get_pod_scores(prompt, MODEL) == {}
+
+            indexer.kv_block_index.add(
+                GOLDEN_HASHES,
+                GOLDEN_HASHES,
+                [PodEntry("pod1", "gpu")],
+            )
+            scores = indexer.get_pod_scores(prompt, MODEL)
+            # 4 consecutive prefix blocks at gpu-tier weight 1.0.
+            assert scores == {"pod1": 4.0}
+        finally:
+            indexer.shutdown()
+
+
+# --- Independent CBOR verification ----------------------------------------
+
+
+def decode_cbor(data: bytes):
+    """Minimal independent RFC 8949 decoder for the payload's type subset.
+
+    Written from the spec (not from the encoder) so a shared misreading
+    would have to be made twice, in two different directions.  Returns
+    the decoded value and asserts *canonical* heads: rejects any
+    argument that could have been encoded shorter.
+    """
+
+    def head(off):
+        ib = data[off]
+        major, info = ib >> 5, ib & 0x1F
+        if info < 24:
+            return major, info, off + 1
+        if info == 24:
+            val = data[off + 1]
+            assert val >= 24, "non-canonical 1-byte head"
+            return major, val, off + 2
+        if info == 25:
+            (val,) = struct.unpack_from(">H", data, off + 1)
+            assert val > 0xFF, "non-canonical 2-byte head"
+            return major, val, off + 3
+        if info == 26:
+            (val,) = struct.unpack_from(">I", data, off + 1)
+            assert val > 0xFFFF, "non-canonical 4-byte head"
+            return major, val, off + 5
+        if info == 27:
+            (val,) = struct.unpack_from(">Q", data, off + 1)
+            assert val > 0xFFFFFFFF, "non-canonical 8-byte head"
+            return major, val, off + 9
+        raise AssertionError(f"indefinite/reserved head {info}")
+
+    def item(off):
+        ib = data[off]
+        if ib == 0xF6:
+            return None, off + 1
+        if ib == 0xF5:
+            return True, off + 1
+        if ib == 0xF4:
+            return False, off + 1
+        major, arg, off = head(off)
+        if major == 0:
+            return arg, off
+        if major == 1:
+            return -1 - arg, off
+        if major == 2:
+            return data[off : off + arg], off + arg
+        if major == 3:
+            return data[off : off + arg].decode("utf-8"), off + arg
+        if major == 4:
+            out = []
+            for _ in range(arg):
+                value, off = item(off)
+                out.append(value)
+            return out, off
+        raise AssertionError(f"unexpected major type {major}")
+
+    value, consumed = item(0)
+    assert consumed == len(data), "trailing bytes after CBOR item"
+    return value
+
+
+class TestCanonicalCBOR:
+    BOUNDARY_INTS = [
+        0, 1, 23, 24, 25, 0xFF, 0x100, 0xFFFF, 0x10000,
+        0xFFFFFFFF, 0x100000000, 0xFFFFFFFFFFFFFFFF,
+    ]
+
+    def test_payload_roundtrips_through_independent_decoder(self):
+        for parent in self.BOUNDARY_INTS:
+            payload = encode_hash_payload(parent, [0, 23, 24, 70000], None)
+            assert decode_cbor(payload) == [parent, [0, 23, 24, 70000], None]
+
+    def test_nil_tokens_encode_as_null(self):
+        payload = encode_hash_payload(5, None, "model")
+        assert decode_cbor(payload) == [5, None, "model"]
+
+    def test_boundary_values_roundtrip(self):
+        for value in self.BOUNDARY_INTS:
+            assert decode_cbor(encode_canonical(value)) == value
+        for value in [-1, -24, -25, -256, -257]:
+            assert decode_cbor(encode_canonical(value)) == value
+        assert decode_cbor(encode_canonical("héllo")) == "héllo"
+        assert decode_cbor(encode_canonical(b"\x00\xff")) == b"\x00\xff"
+        assert decode_cbor(encode_canonical([True, False, None])) == [
+            True,
+            False,
+            None,
+        ]
+
+    def test_known_spec_bytes(self):
+        """Hand-checked byte strings from RFC 8949 appendix A examples."""
+        assert encode_canonical(0) == bytes.fromhex("00")
+        assert encode_canonical(23) == bytes.fromhex("17")
+        assert encode_canonical(24) == bytes.fromhex("1818")
+        assert encode_canonical(1000) == bytes.fromhex("1903e8")
+        assert encode_canonical(1000000) == bytes.fromhex("1a000f4240")
+        assert encode_canonical(1000000000000) == bytes.fromhex(
+            "1b000000e8d4a51000"
+        )
+        assert encode_canonical(-1) == bytes.fromhex("20")
+        assert encode_canonical(-1000) == bytes.fromhex("3903e7")
+        assert encode_canonical("IETF") == bytes.fromhex("6449455446")
+        assert encode_canonical([1, [2, 3], [4, 5]]) == bytes.fromhex(
+            "8301820203820405"
+        )
