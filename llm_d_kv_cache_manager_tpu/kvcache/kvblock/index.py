@@ -105,10 +105,16 @@ class CostAwareIndexConfig:
 
 @dataclass
 class RedisIndexConfig:
+    # Accepts bare host:port or redis:// | rediss:// | valkey:// |
+    # valkeys:// | unix:// URLs, with optional user:pass@ credentials and
+    # /db suffix (reference: redis.go:61-119 via go-redis ParseURL).
     address: str = "127.0.0.1:6379"
     # "redis" or "valkey"; valkey:// URLs are rewritten to redis:// with the
-    # same host/port.
+    # same host/port (valkeys:// to rediss://).
     flavor: str = "redis"
+    # TLS options for rediss:// endpoints.
+    tls_ca_file: Optional[str] = None
+    tls_insecure_skip_verify: bool = False
 
 
 @dataclass

@@ -7,18 +7,26 @@ Capability parity with the reference's Redis backend
 * ``engine:<engine_key>``    -> string holding the request key
 
 Lookups pipeline one ``HKEYS`` per block key in a single round trip; adds
-pipeline ``HSET`` + ``SET``; evictions remove fields and prune empty hashes.
-Valkey endpoints (``valkey://``) speak the same protocol and are accepted.
+pipeline ``HSET`` + ``SET``; evictions remove fields and atomically prune
+the engine mapping with a server-side Lua script (reference:
+redis.go:147-154).  Valkey endpoints (``valkey://``/``valkeys://``) speak
+the same protocol and are accepted; URLs may carry credentials (AUTH on
+connect), a ``/db`` index (SELECT), TLS (``rediss://``), or a ``unix://``
+socket path.
 
 The image ships no redis-py, so this module carries a deliberately small
-RESP2 client (sockets + pipelining) — the indexer only needs six commands.
+RESP2 client (sockets + pipelining) — the indexer needs only a handful of
+commands (HSET/HKEYS/HDEL/SET/GET/DEL plus EVAL, AUTH, SELECT).
 """
 
 from __future__ import annotations
 
 import socket
+import ssl
 import threading
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+from urllib.parse import unquote, urlparse
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
     Index,
@@ -31,26 +39,87 @@ class RespError(RuntimeError):
     """A server-side error reply (``-ERR ...``)."""
 
 
-class RespClient:
-    """Minimal RESP2 client with pipelining and transparent reconnect."""
+@dataclass
+class RedisEndpoint:
+    """A parsed redis/valkey URL (scheme-normalized, credential-aware)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 5.0) -> None:
-        self._host = host
-        self._port = port
+    host: str = "127.0.0.1"
+    port: int = 6379
+    unix_path: Optional[str] = None
+    username: Optional[str] = None
+    password: Optional[str] = None
+    db: int = 0
+    tls: bool = False
+
+
+class RespClient:
+    """Minimal RESP2 client with pipelining and transparent reconnect.
+
+    The connection handshake (TLS, AUTH, SELECT) lives in ``_connect`` so
+    it is replayed automatically when the transport reconnects.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        timeout: float = 5.0,
+        endpoint: Optional[RedisEndpoint] = None,
+        ssl_context: Optional[ssl.SSLContext] = None,
+    ) -> None:
+        self._endpoint = endpoint or RedisEndpoint(host=host, port=port)
         self._timeout = timeout
+        self._ssl_context = ssl_context
+        if self._endpoint.tls and ssl_context is None:
+            self._ssl_context = ssl.create_default_context()
         self._sock = None
         self._reader = None
         self._lock = threading.Lock()
         self._connect()
 
     def _connect(self) -> None:
-        self._sock = socket.create_connection(
-            (self._host, self._port), timeout=self._timeout
-        )
-        # Small request/reply packets: Nagle + delayed ACK otherwise adds
-        # ~40ms stalls per pipelined round trip.
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._reader = self._sock.makefile("rb")
+        ep = self._endpoint
+        if ep.unix_path is not None:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(self._timeout)
+            sock.connect(ep.unix_path)
+        else:
+            sock = socket.create_connection(
+                (ep.host, ep.port), timeout=self._timeout
+            )
+            # Small request/reply packets: Nagle + delayed ACK otherwise
+            # adds ~40ms stalls per pipelined round trip.
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._ssl_context is not None:
+                sock = self._ssl_context.wrap_socket(
+                    sock, server_hostname=ep.host
+                )
+        self._sock = sock
+        self._reader = sock.makefile("rb")
+        self._handshake()
+
+    def _handshake(self) -> None:
+        """AUTH + SELECT on the fresh connection (reference accepts
+        credentialed URLs via go-redis ParseURL, redis.go:61-119)."""
+        ep = self._endpoint
+        commands: List[Sequence] = []
+        # Empty password means "no AUTH" (go-redis ParseURL parity).
+        if ep.password:
+            if ep.username:
+                commands.append(("AUTH", ep.username, ep.password))
+            else:
+                commands.append(("AUTH", ep.password))
+        if ep.db:
+            commands.append(("SELECT", str(ep.db)))
+        if not commands:
+            return
+        payload = b"".join(self._encode(c) for c in commands)
+        self._sock.sendall(payload)
+        for _ in commands:
+            reply = self._read_reply()
+            if isinstance(reply, RespError):
+                self.close()
+                raise reply
 
     def close(self) -> None:
         if self._reader is not None:
@@ -137,28 +206,73 @@ class RespClient:
         raise AssertionError("unreachable")
 
 
-def _parse_address(address: str) -> Tuple[str, int]:
+def parse_redis_url(address: str) -> RedisEndpoint:
+    """Parse a redis/valkey URL into a :class:`RedisEndpoint`.
+
+    Mirrors the reference's normalization (redis.go:72-90): bare
+    ``host:port`` defaults to ``redis://``; ``valkey://`` is rewritten to
+    ``redis://`` and ``valkeys://`` to ``rediss://`` (TLS); ``unix://``
+    selects a Unix-domain socket.  Credentials (``user:pass@``) and a
+    trailing ``/db`` index are honored like go-redis ``ParseURL``.
+    """
     address = address.strip()
-    if address.startswith("rediss://"):
-        raise ValueError(
-            "rediss:// (TLS) endpoints are not supported by the built-in "
-            "RESP client; terminate TLS in front of the indexer instead"
-        )
-    for scheme in ("redis://", "valkey://"):
-        if address.startswith(scheme):
-            address = address[len(scheme):]
-            break
-    address = address.split("/", 1)[0]
-    if "@" in address:
-        raise ValueError(
-            "credentials in the redis address are not supported (AUTH is "
-            "not implemented); use an unauthenticated endpoint"
-        )
-    host, _, port = address.partition(":")
-    return host or "127.0.0.1", int(port or 6379)
+    if "://" not in address:
+        address = "redis://" + address
+    if address.startswith("valkey://"):
+        address = "redis://" + address[len("valkey://"):]
+    elif address.startswith("valkeys://"):
+        address = "rediss://" + address[len("valkeys://"):]
+
+    parsed = urlparse(address)
+    if parsed.scheme not in ("redis", "rediss", "unix"):
+        raise ValueError(f"unsupported redis URL scheme: {parsed.scheme!r}")
+
+    endpoint = RedisEndpoint(tls=parsed.scheme == "rediss")
+    if parsed.username:
+        endpoint.username = unquote(parsed.username)
+    if parsed.password is not None:
+        endpoint.password = unquote(parsed.password)
+
+    if parsed.scheme == "unix":
+        if parsed.hostname:
+            raise ValueError(
+                "unix:// URL must use three slashes (unix:///path/to.sock)"
+                f"; got authority {parsed.hostname!r}"
+            )
+        if not parsed.path:
+            raise ValueError("unix:// URL must carry a socket path")
+        endpoint.unix_path = parsed.path
+        return endpoint
+
+    endpoint.host = parsed.hostname or "127.0.0.1"
+    endpoint.port = parsed.port or 6379
+    db_path = parsed.path.lstrip("/")
+    if db_path:
+        try:
+            endpoint.db = int(db_path)
+        except ValueError as e:
+            raise ValueError(
+                f"invalid database index in redis URL: {db_path!r}"
+            ) from e
+    return endpoint
 
 
 _ENGINE_PREFIX = "engine:"
+
+
+# Atomic prune, byte-identical semantics to the reference's Lua script
+# (redis.go:147-154): only if the request hash is empty (Redis removes
+# hashes whose last field was HDELed) delete the engine->request mapping.
+# Running HLEN + DEL server-side in one script closes the race where a
+# concurrent add lands between the two and is then deleted wholesale.
+_PRUNE_SCRIPT = (
+    "local hashLen = redis.call('HLEN', KEYS[1])\n"
+    "if hashLen == 0 then\n"
+    "    redis.call('DEL', KEYS[2])\n"
+    "    return 1\n"
+    "end\n"
+    "return 0"
+)
 
 
 class RedisIndex(Index):
@@ -169,8 +283,18 @@ class RedisIndex(Index):
     ) -> None:
         self.config = config or RedisIndexConfig()
         if client is None:
-            host, port = _parse_address(self.config.address)
-            client = RespClient(host, port)
+            endpoint = parse_redis_url(self.config.address)
+            ssl_context = None
+            if endpoint.tls:
+                ssl_context = ssl.create_default_context(
+                    cafile=self.config.tls_ca_file
+                )
+                if self.config.tls_insecure_skip_verify:
+                    ssl_context.check_hostname = False
+                    ssl_context.verify_mode = ssl.CERT_NONE
+            client = RespClient(
+                endpoint=endpoint, ssl_context=ssl_context
+            )
         self._client = client
 
     @staticmethod
@@ -246,18 +370,22 @@ class RedisIndex(Index):
         request_key = request_key_raw.decode()
         hdel: List = ["HDEL", request_key]
         hdel += [self._field(entry) for entry in entries]
-        _, remaining = self._client.pipeline(
-            [hdel, ("HLEN", request_key)]
+        # HDEL of the last field removes the hash itself server-side; the
+        # Lua prune then atomically deletes the engine->request mapping
+        # only if the hash is still empty, so an add racing in between is
+        # never lost.
+        self._client.pipeline(
+            [
+                hdel,
+                (
+                    "EVAL",
+                    _PRUNE_SCRIPT,
+                    "2",
+                    request_key,
+                    f"{_ENGINE_PREFIX}{engine_key}",
+                ),
+            ]
         )
-        if remaining == 0:
-            # Benign race window with a concurrent add, as in the reference's
-            # Lua prune; an empty hash left behind is harmless.
-            self._client.pipeline(
-                [
-                    ("DEL", request_key),
-                    ("DEL", f"{_ENGINE_PREFIX}{engine_key}"),
-                ]
-            )
 
     def get_request_key(self, engine_key: int) -> int:
         raw = self._client.execute("GET", f"{_ENGINE_PREFIX}{engine_key}")

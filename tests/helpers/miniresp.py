@@ -2,15 +2,18 @@
 
 Test-only stand-in following the reference's miniredis pattern
 (pkg/kvcache/kvblock/redis_test.go:29-45): no real server required.
-Supports HSET / HKEYS / HDEL / HLEN / SET / GET / DEL / PING / FLUSHALL.
+Supports HSET / HKEYS / HDEL / HLEN / SET / GET / DEL / PING / FLUSHALL /
+AUTH / SELECT, the index's atomic-prune EVAL script, and optional
+``requirepass`` / TLS so the authenticated and encrypted handshakes can be
+exercised end-to-end.
 """
 
 from __future__ import annotations
 
-import socket
 import socketserver
+import ssl
 import threading
-from typing import Dict
+from typing import Dict, Optional
 
 
 class _State:
@@ -28,6 +31,19 @@ def _bulk(data) -> bytes:
     return b"$%d\r\n%s\r\n" % (len(data), data)
 
 
+def _normalize_script(script: bytes) -> bytes:
+    return b" ".join(script.split())
+
+
+# The only script the index runs: atomic prune of the engine->request
+# mapping (semantics of the reference's redis.go:147-154 Lua script).
+_PRUNE_FINGERPRINT = _normalize_script(
+    b"local hashLen = redis.call('HLEN', KEYS[1]) "
+    b"if hashLen == 0 then redis.call('DEL', KEYS[2]) return 1 end "
+    b"return 0"
+)
+
+
 class _Handler(socketserver.StreamRequestHandler):
     # Real Redis disables Nagle on accepted sockets; without this, each
     # small per-command reply stalls ~40ms on the peer's delayed ACK.
@@ -35,10 +51,11 @@ class _Handler(socketserver.StreamRequestHandler):
 
     def handle(self) -> None:
         state: _State = self.server.state  # type: ignore[attr-defined]
+        self._authed = self.server.password is None  # type: ignore[attr-defined]
         while True:
             try:
                 command = self._read_command()
-            except (ConnectionError, ValueError):
+            except (ConnectionError, ValueError, OSError):
                 return
             if command is None:
                 return
@@ -60,11 +77,34 @@ class _Handler(socketserver.StreamRequestHandler):
             args.append(self.rfile.read(length + 2)[:-2])
         return args
 
+    def _auth(self, args) -> bytes:
+        password = self.server.password  # type: ignore[attr-defined]
+        if password is None:
+            return b"-ERR Client sent AUTH, but no password is set\r\n"
+        if len(args) == 2:
+            user, given = b"default", args[1]
+        elif len(args) == 3:
+            user, given = args[1], args[2]
+        else:
+            return b"-ERR wrong number of arguments for 'auth' command\r\n"
+        if user != b"default" or given != password.encode():
+            return b"-WRONGPASS invalid username-password pair\r\n"
+        self._authed = True
+        return b"+OK\r\n"
+
     def _dispatch(self, state: _State, args) -> bytes:
         cmd = args[0].upper()
+        if cmd == b"AUTH":
+            return self._auth(args)
+        if not self._authed:
+            return b"-NOAUTH Authentication required.\r\n"
         with state.lock:
             if cmd == b"PING":
                 return b"+PONG\r\n"
+            if cmd == b"SELECT":
+                # Single logical database; accept the index for handshake
+                # compatibility.
+                return b"+OK\r\n"
             if cmd == b"SET":
                 state.strings[args[1]] = args[2]
                 return b"+OK\r\n"
@@ -99,24 +139,59 @@ class _Handler(socketserver.StreamRequestHandler):
                 return b":%d\r\n" % removed
             if cmd == b"HLEN":
                 return b":%d\r\n" % len(state.hashes.get(args[1], {}))
+            if cmd == b"EVAL":
+                return self._eval(state, args)
             if cmd == b"FLUSHALL":
                 state.strings.clear()
                 state.hashes.clear()
                 return b"+OK\r\n"
         return b"-ERR unknown command '%s'\r\n" % cmd
 
+    def _eval(self, state: _State, args) -> bytes:
+        """Execute the index's prune script atomically (caller holds the
+        state lock, which is what makes it atomic here)."""
+        if len(args) < 3:
+            return b"-ERR wrong number of arguments for 'eval' command\r\n"
+        if _normalize_script(args[1]) != _PRUNE_FINGERPRINT:
+            return b"-ERR unsupported script for miniresp\r\n"
+        if args[2] != b"2" or len(args) != 5:
+            return b"-ERR prune script expects exactly 2 keys\r\n"
+        request_key, engine_key = args[3], args[4]
+        if len(state.hashes.get(request_key, {})) == 0:
+            state.strings.pop(engine_key, None)
+            state.hashes.pop(request_key, None)
+            return b":1\r\n"
+        return b":0\r\n"
+
 
 class MiniRespServer:
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        password: Optional[str] = None,
+        ssl_context: Optional[ssl.SSLContext] = None,
+    ) -> None:
         self._server = socketserver.ThreadingTCPServer(
             ("127.0.0.1", 0), _Handler, bind_and_activate=True
         )
         self._server.daemon_threads = True
         self._server.state = _State()  # type: ignore[attr-defined]
+        self._server.password = password  # type: ignore[attr-defined]
+        if ssl_context is not None:
+            self._server.socket = ssl_context.wrap_socket(
+                self._server.socket, server_side=True
+            )
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True
         )
         self._thread.start()
+
+    @property
+    def state(self) -> _State:
+        return self._server.state  # type: ignore[attr-defined]
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
 
     @property
     def address(self) -> str:

@@ -1,0 +1,291 @@
+"""RESP client URL parsing, AUTH/TLS handshakes, and eviction atomicity.
+
+Covers the reference's credentialed/TLS URL acceptance (redis.go:61-119)
+and the atomic Lua prune (redis.go:147-154) — including a controlled
+interleave proving that an add racing into the HDEL->prune window is
+never lost (the failure mode of a non-atomic HLEN->DEL sequence).
+"""
+
+import ssl
+import subprocess
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    PodEntry,
+    RedisIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+    _ENGINE_PREFIX,
+    _PRUNE_SCRIPT,
+    RedisIndex,
+    RespClient,
+    RespError,
+    parse_redis_url,
+)
+from tests.helpers.miniresp import MiniRespServer
+
+POD1 = PodEntry("pod-1", "hbm")
+POD2 = PodEntry("pod-2", "hbm")
+
+
+class TestParseRedisURL:
+    def test_bare_host_port(self):
+        ep = parse_redis_url("example.com:7000")
+        assert (ep.host, ep.port, ep.tls) == ("example.com", 7000, False)
+        assert ep.password is None and ep.db == 0
+
+    def test_defaults(self):
+        ep = parse_redis_url("redis://")
+        assert (ep.host, ep.port) == ("127.0.0.1", 6379)
+
+    def test_valkey_rewrites(self):
+        assert not parse_redis_url("valkey://h:1").tls
+        assert parse_redis_url("valkeys://h:1").tls
+
+    def test_credentials_and_db(self):
+        ep = parse_redis_url("redis://user:s%40cret@h:6380/3")
+        assert ep.username == "user"
+        assert ep.password == "s@cret"
+        assert (ep.host, ep.port, ep.db) == ("h", 6380, 3)
+
+    def test_password_only(self):
+        ep = parse_redis_url("redis://:pw@h")
+        assert ep.username is None or ep.username == ""
+        assert ep.password == "pw"
+
+    def test_unix_socket(self):
+        ep = parse_redis_url("unix:///var/run/redis.sock")
+        assert ep.unix_path == "/var/run/redis.sock"
+
+    def test_rejects_unknown_scheme(self):
+        with pytest.raises(ValueError):
+            parse_redis_url("http://h:1")
+
+    def test_rejects_bad_db(self):
+        with pytest.raises(ValueError):
+            parse_redis_url("redis://h:1/notanumber")
+
+
+class TestAuthHandshake:
+    def test_authenticated_roundtrip(self):
+        server = MiniRespServer(password="hunter2")
+        try:
+            idx = RedisIndex(
+                RedisIndexConfig(
+                    address=f"redis://:hunter2@{server.address}"
+                )
+            )
+            idx.add([1], [101], [POD1])
+            assert idx.lookup([101]) == {101: [POD1]}
+        finally:
+            server.close()
+
+    def test_wrong_password_rejected(self):
+        server = MiniRespServer(password="hunter2")
+        try:
+            with pytest.raises(RespError):
+                RedisIndex(
+                    RedisIndexConfig(
+                        address=f"redis://:wrong@{server.address}"
+                    )
+                )
+        finally:
+            server.close()
+
+    def test_unauthenticated_client_refused(self):
+        server = MiniRespServer(password="hunter2")
+        try:
+            client = RespClient("127.0.0.1", server.port)
+            with pytest.raises(RespError, match="NOAUTH"):
+                client.execute("PING")
+        finally:
+            server.close()
+
+    def test_username_password_pair(self):
+        server = MiniRespServer(password="hunter2")
+        try:
+            client = RespClient(
+                endpoint=parse_redis_url(
+                    f"redis://default:hunter2@{server.address}"
+                )
+            )
+            assert client.execute("PING") == "PONG"
+        finally:
+            server.close()
+
+    def test_reconnect_replays_auth(self):
+        server = MiniRespServer(password="hunter2")
+        try:
+            client = RespClient(
+                endpoint=parse_redis_url(
+                    f"redis://:hunter2@{server.address}"
+                )
+            )
+            assert client.execute("PING") == "PONG"
+            client.close()  # force the transparent-reconnect path
+            assert client.execute("PING") == "PONG"
+        finally:
+            server.close()
+
+
+@pytest.fixture(scope="module")
+def tls_cert(tmp_path_factory):
+    base = tmp_path_factory.mktemp("tls")
+    key, cert = str(base / "key.pem"), str(base / "cert.pem")
+    proc = subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048",
+            "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+            "-subj", "/CN=127.0.0.1",
+            "-addext", "subjectAltName=IP:127.0.0.1",
+        ],
+        capture_output=True,
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"openssl unavailable: {proc.stderr[-200:]}")
+    return key, cert
+
+
+class TestTLSHandshake:
+    def _server(self, tls_cert, password=None):
+        key, cert = tls_cert
+        context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        context.load_cert_chain(cert, key)
+        return MiniRespServer(password=password, ssl_context=context)
+
+    def test_rediss_with_ca_file(self, tls_cert):
+        server = self._server(tls_cert)
+        try:
+            idx = RedisIndex(
+                RedisIndexConfig(
+                    address=f"rediss://127.0.0.1:{server.port}",
+                    tls_ca_file=tls_cert[1],
+                )
+            )
+            idx.add([2], [202], [POD1])
+            assert idx.lookup([202]) == {202: [POD1]}
+        finally:
+            server.close()
+
+    def test_untrusted_cert_rejected(self, tls_cert):
+        server = self._server(tls_cert)
+        try:
+            with pytest.raises((ssl.SSLError, OSError)):
+                RedisIndex(
+                    RedisIndexConfig(
+                        address=f"rediss://127.0.0.1:{server.port}"
+                    )
+                )
+        finally:
+            server.close()
+
+    def test_insecure_skip_verify(self, tls_cert):
+        server = self._server(tls_cert)
+        try:
+            idx = RedisIndex(
+                RedisIndexConfig(
+                    address=f"valkeys://127.0.0.1:{server.port}",
+                    tls_insecure_skip_verify=True,
+                )
+            )
+            idx.add([3], [303], [POD1])
+            assert idx.lookup([303]) == {303: [POD1]}
+        finally:
+            server.close()
+
+    def test_tls_with_auth(self, tls_cert):
+        server = self._server(tls_cert, password="pw")
+        try:
+            idx = RedisIndex(
+                RedisIndexConfig(
+                    address=f"rediss://:pw@127.0.0.1:{server.port}",
+                    tls_ca_file=tls_cert[1],
+                )
+            )
+            idx.add([4], [404], [POD1])
+            assert idx.lookup([404]) == {404: [POD1]}
+        finally:
+            server.close()
+
+
+class TestEvictionAtomicity:
+    def test_add_racing_into_prune_window_survives(self):
+        """Deterministic interleave of the historical lost-add race:
+
+        evictor:  HDEL last field          (hash now empty)
+        adder:            HSET pod2 + SET engine   <- lands in the window
+        evictor:  prune script             (must NOT delete the new add)
+        """
+        server = MiniRespServer()
+        try:
+            evictor = RespClient("127.0.0.1", server.port)
+            adder = RespClient("127.0.0.1", server.port)
+            rk, ek = "9001", f"{_ENGINE_PREFIX}77"
+
+            adder.execute("HSET", rk, "pod-1@hbm", "1")
+            adder.execute("SET", ek, rk)
+
+            evictor.execute("HDEL", rk, "pod-1@hbm")
+            adder.pipeline(
+                [("HSET", rk, "pod-2@hbm", "1"), ("SET", ek, rk)]
+            )
+            result = evictor.execute("EVAL", _PRUNE_SCRIPT, "2", rk, ek)
+
+            assert result == 0  # hash non-empty: nothing pruned
+            assert adder.execute("HKEYS", rk) == [b"pod-2@hbm"]
+            assert adder.execute("GET", ek) == rk.encode()
+        finally:
+            server.close()
+
+    def test_prune_after_true_emptiness(self):
+        server = MiniRespServer()
+        try:
+            idx = RedisIndex(
+                RedisIndexConfig(address=f"redis://{server.address}")
+            )
+            idx.add([7], [707], [POD1])
+            idx.evict(7, [POD1])
+            assert idx.lookup([707]) == {}
+            with pytest.raises(KeyError):
+                idx.get_request_key(7)
+        finally:
+            server.close()
+
+    def test_concurrent_add_evict_stress_no_lost_adds(self):
+        server = MiniRespServer()
+        try:
+            idx_a = RedisIndex(
+                RedisIndexConfig(address=f"redis://{server.address}")
+            )
+            idx_b = RedisIndex(
+                RedisIndexConfig(address=f"redis://{server.address}")
+            )
+            stop = threading.Event()
+            errors = []
+
+            def evictor():
+                while not stop.is_set():
+                    try:
+                        idx_b.evict(11, [POD1, POD2])
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+
+            thread = threading.Thread(target=evictor)
+            thread.start()
+            try:
+                for _ in range(300):
+                    idx_a.add([11], [1111], [POD1])
+            finally:
+                stop.set()
+                thread.join(timeout=10)
+            assert not errors
+            # The last operation was an add: the entry must exist no
+            # matter how evictions interleaved.
+            idx_a.add([11], [1111], [POD1])
+            assert idx_a.lookup([1111]) == {1111: [POD1]}
+            assert idx_a.get_request_key(11) == 1111
+        finally:
+            server.close()
