@@ -140,8 +140,14 @@ def value_to_python(value: tokenizer_pb2.Value):
     if kind == "string_value":
         return value.string_value
     if kind == "number_value":
-        number = value.number_value
-        return int(number) if float(number).is_integer() else number
+        # Always a float: ints travel as int_value, so 2.0 stays 2.0 and
+        # sidecar rendering agrees with the in-process path.  Version
+        # skew note: upgrade decode sides (servers) before encode sides —
+        # an old server's pb2 lacks int_value and would null-out integer
+        # kwargs sent by a new client.
+        return value.number_value
+    if kind == "int_value":
+        return value.int_value
     if kind == "bool_value":
         return value.bool_value
     if kind == "list_value":
@@ -160,8 +166,13 @@ def python_to_value(obj) -> tokenizer_pb2.Value:
         value.bool_value = obj
     elif isinstance(obj, str):
         value.string_value = obj
-    elif isinstance(obj, (int, float)):
-        value.number_value = float(obj)
+    elif isinstance(obj, int):
+        if -(2**63) <= obj < 2**63:
+            value.int_value = obj
+        else:  # beyond sint64: lossy float, as the old encoding was
+            value.number_value = float(obj)
+    elif isinstance(obj, float):
+        value.number_value = obj
     elif isinstance(obj, (list, tuple)):
         value.list_value.values.extend(python_to_value(item) for item in obj)
     elif isinstance(obj, dict):

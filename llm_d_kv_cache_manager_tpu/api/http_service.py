@@ -160,7 +160,10 @@ def main() -> None:  # pragma: no cover - CLI entry
     """Env-configured standalone service: indexer + event subscription
     (the reference's online example, main.go:93-148)."""
     from llm_d_kv_cache_manager_tpu.kvcache.indexer import IndexerConfig
-    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+        IndexConfig,
+        RedisIndexConfig,
+    )
     from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
         TokenProcessorConfig,
     )
@@ -182,7 +185,22 @@ def main() -> None:  # pragma: no cover - CLI entry
         ),
         kvblock_index_config=IndexConfig(
             enable_metrics=os.environ.get("ENABLE_METRICS", "true").lower()
-            != "false"
+            != "false",
+            # e.g. INDEX_BACKEND=valkey://valkey:6379 selects the shared
+            # distributed index; unset keeps the in-memory backend.
+            redis_config=(
+                RedisIndexConfig(
+                    address=os.environ["INDEX_BACKEND"],
+                    tls_ca_file=os.environ.get("INDEX_TLS_CA_FILE")
+                    or None,
+                    tls_insecure_skip_verify=os.environ.get(
+                        "INDEX_TLS_INSECURE", ""
+                    ).lower()
+                    in ("1", "true", "yes"),
+                )
+                if os.environ.get("INDEX_BACKEND")
+                else None
+            ),
         ),
         tokenizers_pool_config=TokenizationPoolConfig(
             model_name=os.environ.get("MODEL_NAME", "")
@@ -201,11 +219,47 @@ def main() -> None:  # pragma: no cover - CLI entry
         ),
     )
     pool.start()
-    manager = SubscriberManager(sink=pool.add_task, bind=True)
-    endpoint = os.environ.get("ZMQ_ENDPOINT", "tcp://*:5557")
-    manager.ensure_subscriber(
-        "global", endpoint, topic_filter=os.environ.get("ZMQ_TOPIC", "kv@")
+    # Two event-ingestion modes (reference online example supports both):
+    # - POD_DISCOVERY=true: watch the k8s API and dial out to each serving
+    #   pod's ZMQ socket (needs the pod list/watch RBAC grant);
+    # - default: bind one global SUB socket engines connect to.
+    discover = os.environ.get("POD_DISCOVERY", "").lower() in (
+        "1",
+        "true",
+        "yes",
     )
+    manager = SubscriberManager(sink=pool.add_task, bind=not discover)
+    reconciler = None
+    if discover:
+        from llm_d_kv_cache_manager_tpu.kvevents.pod_reconciler import (
+            DEFAULT_LABEL_SELECTOR,
+            PodReconciler,
+            PodReconcilerConfig,
+        )
+
+        reconciler = PodReconciler(
+            manager,
+            PodReconcilerConfig(
+                namespace=os.environ.get("POD_NAMESPACE") or None,
+                label_selector=os.environ.get(
+                    "POD_LABEL_SELECTOR", DEFAULT_LABEL_SELECTOR
+                ),
+                socket_port=int(os.environ.get("POD_SOCKET_PORT", "5557")),
+                topic_filter=os.environ.get("ZMQ_TOPIC", "kv@"),
+                # Out-of-cluster override (local runs / tests); in-cluster
+                # the service-account environment is discovered.
+                api_server=os.environ.get("POD_API_SERVER") or None,
+                token=os.environ.get("POD_API_TOKEN") or None,
+            ),
+        )
+        reconciler.start()
+    else:
+        endpoint = os.environ.get("ZMQ_ENDPOINT", "tcp://*:5557")
+        manager.ensure_subscriber(
+            "global",
+            endpoint,
+            topic_filter=os.environ.get("ZMQ_TOPIC", "kv@"),
+        )
 
     stop_beat = start_metrics_logging(
         float(os.environ.get("METRICS_LOGGING_INTERVAL", "60"))
@@ -218,6 +272,8 @@ def main() -> None:  # pragma: no cover - CLI entry
     finally:
         stop_beat.set()
         server.shutdown()
+        if reconciler is not None:
+            reconciler.stop()
         manager.shutdown()
         pool.shutdown()
         indexer.shutdown()

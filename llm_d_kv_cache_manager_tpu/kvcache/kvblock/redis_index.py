@@ -233,6 +233,24 @@ def parse_redis_url(address: str) -> RedisEndpoint:
     if parsed.password is not None:
         endpoint.password = unquote(parsed.password)
 
+    # go-redis parity: ?db=N (the only way to select a db on a unix
+    # socket); any other query key is rejected loudly rather than
+    # silently ignored.
+    if parsed.query:
+        for pair in parsed.query.split("&"):
+            key, _, raw = pair.partition("=")
+            if key == "db":
+                try:
+                    endpoint.db = int(raw)
+                except ValueError as e:
+                    raise ValueError(
+                        f"invalid db index in redis URL query: {raw!r}"
+                    ) from e
+            else:
+                raise ValueError(
+                    f"unsupported redis URL query parameter: {key!r}"
+                )
+
     if parsed.scheme == "unix":
         if parsed.hostname:
             raise ValueError(

@@ -52,6 +52,12 @@ class PodReconcilerConfig:
     token: Optional[str] = None
     ca_cert_path: Optional[str] = None
     reconnect_seconds: float = 5.0
+    # Server-side watch expiry: the API server ends the stream after this
+    # many seconds and the loop re-lists — the liveness bound that keeps a
+    # half-open TCP connection (node failover, LB idle drop without FIN)
+    # from blocking the reconciler forever.  The socket read timeout is
+    # set slightly above it so it only trips on genuinely dead streams.
+    watch_timeout_seconds: float = 240.0
 
 
 class KubeClient:
@@ -117,15 +123,20 @@ class KubeClient:
 
     def watch_pods(self, resource_version: str):
         """Yield watch events until the stream ends or errors."""
+        watch_timeout = self.config.watch_timeout_seconds
         query = {
             "labelSelector": self.config.label_selector,
             "watch": "true",
             "resourceVersion": resource_version,
             "allowWatchBookmarks": "true",
+            "timeoutSeconds": str(int(watch_timeout)),
         }
-        # No read timeout: the server holds the stream open between
-        # events; the poll loop handles liveness via reconnects.
-        with self._open(self._pods_path(query), timeout=None) as response:
+        # A healthy stream ends server-side at timeoutSeconds; the read
+        # timeout sits above that so it fires only when the connection is
+        # half-open and no FIN will ever arrive.
+        with self._open(
+            self._pods_path(query), timeout=watch_timeout + 60
+        ) as response:
             for line in response:
                 line = line.strip()
                 if line:
@@ -206,20 +217,25 @@ class PodReconciler:
     def run_once(self) -> None:
         """One list+watch cycle (returns when the stream drops)."""
         resource_version = self.reconcile_list(self.client.list_pods())
-        for event in self.client.watch_pods(resource_version):
-            if self._stop.is_set():
-                return
-            kind = event.get("type", "")
-            if kind == "BOOKMARK":
-                continue
-            if kind == "ERROR":
-                # e.g. 410 Gone: resourceVersion too old -> re-list.
-                logger.info("watch error event %s; re-listing", event)
-                return
-            obj = event.get("object", {})
-            if obj.get("kind") not in (None, "Pod"):
-                continue
-            self.reconcile(kind, obj)
+        try:
+            for event in self.client.watch_pods(resource_version):
+                if self._stop.is_set():
+                    return
+                kind = event.get("type", "")
+                if kind == "BOOKMARK":
+                    continue
+                if kind == "ERROR":
+                    # e.g. 410 Gone: resourceVersion too old -> re-list.
+                    logger.info("watch error event %s; re-listing", event)
+                    return
+                obj = event.get("object", {})
+                if obj.get("kind") not in (None, "Pod"):
+                    continue
+                self.reconcile(kind, obj)
+        except TimeoutError:
+            # Dead (half-open) stream: treat like a normal stream end and
+            # let the loop re-list.
+            logger.info("watch read timed out; re-listing")
 
     def _loop(self) -> None:
         while not self._stop.is_set():

@@ -42,6 +42,16 @@ class Publisher:
     def topic(self) -> str:
         return f"{TOPIC_PREFIX}{self.pod_identifier}@{self.model_name}"
 
+    @property
+    def endpoint(self) -> str:
+        """The actual endpoint, post-bind — with the OS-assigned port when
+        bound to port 0 (lets tests avoid fixed-port flakes)."""
+        return self._socket.getsockopt(zmq.LAST_ENDPOINT).decode()
+
+    @property
+    def port(self) -> int:
+        return int(self.endpoint.rsplit(":", 1)[1])
+
     def publish(self, *events) -> int:
         """Publish events as one batch; returns the sequence number used."""
         batch = EventBatch(ts=time.time(), events=list(events))

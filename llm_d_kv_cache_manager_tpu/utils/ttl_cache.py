@@ -27,14 +27,20 @@ class TTLCache(Generic[K, V]):
         self._on_evict = on_evict
         self._entries: Dict[K, tuple] = {}  # key -> (value, deadline)
         self._lock = threading.Lock()
+        # Serializes set() against expiry callbacks so a re-insert can
+        # never interleave between the is-it-still-absent check and the
+        # on_evict call (which would tear down the fresh state).  RLock
+        # so an on_evict callback may itself call set().
+        self._cb_lock = threading.RLock()
         self._sweeper: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     def set(self, key: K, value: V, ttl_seconds: Optional[float] = None):
         """Insert or refresh; refreshing resets the deadline."""
         deadline = time.monotonic() + (ttl_seconds or self.ttl_seconds)
-        with self._lock:
-            self._entries[key] = (value, deadline)
+        with self._cb_lock:
+            with self._lock:
+                self._entries[key] = (value, deadline)
 
     def get(self, key: K) -> Optional[V]:
         with self._lock:
@@ -46,8 +52,7 @@ class TTLCache(Generic[K, V]):
                 del self._entries[key]
             else:
                 return value
-        if self._on_evict is not None:
-            self._on_evict(key, value)
+        self._fire_eviction(key, value)
         return None
 
     def delete(self, key: K) -> bool:
@@ -69,10 +74,23 @@ class TTLCache(Generic[K, V]):
                 if deadline < now:
                     del self._entries[key]
                     expired.append((key, value))
-        if self._on_evict is not None:
-            for key, value in expired:
-                self._on_evict(key, value)
+        for key, value in expired:
+            self._fire_eviction(key, value)
         return len(expired)
+
+    def _fire_eviction(self, key: K, value: V) -> None:
+        """Run ``on_evict`` outside the entry lock, skipping it if the
+        key was re-inserted between removal and now — otherwise a
+        concurrent ``set`` has its fresh state torn down by the stale
+        eviction.  Holding ``_cb_lock`` across check+callback makes the
+        skip airtight: ``set`` cannot land in between."""
+        if self._on_evict is None:
+            return
+        with self._cb_lock:
+            with self._lock:
+                if key in self._entries:
+                    return
+            self._on_evict(key, value)
 
     def start_sweeper(self, interval_seconds: Optional[float] = None) -> None:
         """Spawn the periodic cleaner (idempotent)."""

@@ -173,3 +173,22 @@ class TestTTLCacheUnderContention:
         # No exceptions and the cache still functions.
         cache.set("alive", 1)
         assert cache.get("alive") == 1
+
+    def test_eviction_skipped_when_key_reinserted(self):
+        """A set() landing between expiry-removal and the on_evict call
+        must not have its fresh entry torn down by the stale eviction
+        (the subscriber-lifecycle race in the scheduler plugin)."""
+        evicted = []
+        cache = TTLCache(60.0, on_evict=lambda k, v: evicted.append((k, v)))
+
+        # Deterministic interleave of the race window: the key was
+        # already removed under the lock, and a concurrent set()
+        # re-inserted it before the callback fires.
+        cache.set("pod", "fresh-subscriber")
+        cache._fire_eviction("pod", "stale-subscriber")
+        assert evicted == []
+
+        # Once the key is truly absent the eviction does fire.
+        cache.delete("pod")
+        cache._fire_eviction("pod", "stale-subscriber")
+        assert evicted == [("pod", "stale-subscriber")]

@@ -216,10 +216,20 @@ class TestValueCodec:
         }
         assert value_to_python(python_to_value(payload)) == payload
 
-    def test_integral_floats_decode_as_int(self):
+    def test_int_float_distinction_survives_roundtrip(self):
+        """{"temperature": 2.0} must arrive as float 2.0 (not int 2) so
+        sidecar and in-process Jinja rendering agree; ints travel on the
+        distinct int_value encoding."""
+        as_float = value_to_python(python_to_value(2.0))
+        assert as_float == 2.0 and isinstance(as_float, float)
+        as_int = value_to_python(python_to_value(2))
+        assert as_int == 2 and isinstance(as_int, int)
+        assert value_to_python(python_to_value(-(2**40))) == -(2**40)
+
+    def test_number_value_stays_float(self):
         value = tokenizer_pb2.Value(number_value=7.0)
-        assert value_to_python(value) == 7
-        assert isinstance(value_to_python(value), int)
+        assert value_to_python(value) == 7.0
+        assert isinstance(value_to_python(value), float)
 
 
 class TestUdsInIndexerConfig:

@@ -59,6 +59,14 @@ class TestParseRedisURL:
         ep = parse_redis_url("unix:///var/run/redis.sock")
         assert ep.unix_path == "/var/run/redis.sock"
 
+    def test_unix_socket_db_query(self):
+        ep = parse_redis_url("unix:///var/run/redis.sock?db=3")
+        assert ep.db == 3
+
+    def test_unknown_query_param_rejected(self):
+        with pytest.raises(ValueError, match="query parameter"):
+            parse_redis_url("redis://h:1?ssl_cert_reqs=none")
+
     def test_rejects_unknown_scheme(self):
         with pytest.raises(ValueError):
             parse_redis_url("http://h:1")

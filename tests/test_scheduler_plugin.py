@@ -220,9 +220,17 @@ class TestDiscoveryTopicFilter:
             ),
             tokenizer=LocalFastTokenizer(tokenizer_dir),
         )
+        # Bind to port 0 so the OS picks a free port (fixed ports flake
+        # under parallel test runs); the scorer then dials that port.
+        publisher = Publisher(
+            "tcp://127.0.0.1:0",
+            pod_identifier="127.0.0.1",  # engine id != "ns/pod-a"
+            model_name=MODEL,
+            bind=True,
+        )
         scorer = PrecisePrefixCacheScorer(
             PrecisePrefixCacheScorerConfig(
-                discover_pods=True, pod_socket_port=15903
+                discover_pods=True, pod_socket_port=publisher.port
             ),
             indexer=indexer,
         )
@@ -232,12 +240,6 @@ class TestDiscoveryTopicFilter:
         )
         try:
             assert scorer.score(request, pods)[pods[0]] == 0.0
-            publisher = Publisher(
-                "tcp://127.0.0.1:15903",
-                pod_identifier="127.0.0.1",  # engine id != "ns/pod-a"
-                model_name=MODEL,
-                bind=True,
-            )
             _time.sleep(1.0)  # slow joiner
             from llm_d_kv_cache_manager_tpu.kvevents.events import (
                 BlockStored,
@@ -408,4 +410,61 @@ class TestPodReconciler:
         )
         pod = make_pod("pod-a", ip="fd00::1")
         assert reconciler._endpoint(pod) == "tcp://[fd00::1]:5557"
+        manager.shutdown()
+
+    def test_watch_requests_server_side_timeout(self, fake_kube):
+        """The watch must carry timeoutSeconds so the API server ends the
+        stream periodically — the liveness bound against half-open TCP
+        connections that would otherwise block the loop forever."""
+        FakeKubeHandler.pods = []
+        FakeKubeHandler.watch_events = []
+        seen_paths = []
+        original = FakeKubeHandler.do_GET
+
+        def spy(handler):
+            seen_paths.append(handler.path)
+            original(handler)
+
+        FakeKubeHandler.do_GET = spy
+        try:
+            manager = RecordingManager()
+            reconciler = PodReconciler(
+                manager,
+                PodReconcilerConfig(
+                    namespace="llm-d",
+                    api_server=fake_kube,
+                    token="t",
+                    watch_timeout_seconds=123,
+                ),
+            )
+            reconciler.run_once()
+            watch_paths = [p for p in seen_paths if "watch=true" in p]
+            assert watch_paths and "timeoutSeconds=123" in watch_paths[0]
+            manager.shutdown()
+        finally:
+            FakeKubeHandler.do_GET = original
+
+    def test_read_timeout_is_a_normal_stream_end(self):
+        """A dead (half-open) stream raises TimeoutError mid-iteration;
+        run_once must swallow it and return so the loop re-lists."""
+        manager = RecordingManager()
+        reconciler = PodReconciler(
+            manager,
+            PodReconcilerConfig(api_server="http://127.0.0.1:1", token="t"),
+        )
+
+        class DeadStreamClient:
+            def list_pods(self):
+                return {"metadata": {"resourceVersion": "1"}, "items": []}
+
+            def watch_pods(self, resource_version):
+                yield {
+                    "type": "ADDED",
+                    "object": make_pod("pod-a", ip="10.0.0.1"),
+                }
+                raise TimeoutError("read timed out")
+
+        reconciler.client = DeadStreamClient()
+        reconciler.run_once()  # must not raise
+        assert manager.active_pods() == ["llm-d/pod-a"]
         manager.shutdown()
