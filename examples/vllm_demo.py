@@ -27,6 +27,8 @@ BLOCK_SIZE = int(os.environ.get("BLOCK_SIZE", "16"))
 ZMQ_ENDPOINT = os.environ.get("ZMQ_ENDPOINT", "tcp://localhost:5557")
 POD = os.environ.get("POD_IDENTIFIER", "localhost")
 
+SHARED_STORAGE = os.environ.get("SHARED_STORAGE_PATH", "/mnt/kv-cache")
+
 RECIPE = f"""\
 vLLM not installed — to run this demo on a serving host:
 
@@ -37,6 +39,19 @@ vLLM not installed — to run this demo on a serving host:
         "publisher": "zmq",
         "endpoint": "{ZMQ_ENDPOINT.replace("localhost", "*")}",
         "topic": "kv@{POD}@{MODEL}"
+      }}' \\
+    --kv-transfer-config '{{
+        "kv_connector": "OffloadingConnector",
+        "kv_role": "kv_both",
+        "kv_connector_extra_config": {{
+          "spec_name": "TPUSharedStorageOffloadingSpec",
+          "spec_module_path":
+            "llm_d_kv_cache_manager_tpu.offload.vllm_spec",
+          "shared_storage_path": "{SHARED_STORAGE}",
+          "block_size": {BLOCK_SIZE * 4},
+          "threads_per_chip": 8,
+          "max_staging_memory_gb": 16
+        }}
       }}' \\
     --prefix-caching-hash-algo sha256_cbor
 
@@ -91,10 +106,27 @@ def main() -> None:
 
     from vllm import LLM, SamplingParams
 
+    # Wire the TPU shared-storage offload connector (offload/vllm_spec.py)
+    # so evicted blocks page to shared storage and can be re-served.
+    kv_transfer_config = {
+        "kv_connector": "OffloadingConnector",
+        "kv_role": "kv_both",
+        "kv_connector_extra_config": {
+            "spec_name": "TPUSharedStorageOffloadingSpec",
+            "spec_module_path": (
+                "llm_d_kv_cache_manager_tpu.offload.vllm_spec"
+            ),
+            "shared_storage_path": SHARED_STORAGE,
+            "block_size": BLOCK_SIZE * 4,
+            "threads_per_chip": 8,
+            "max_staging_memory_gb": 16,
+        },
+    }
     llm = LLM(
         model=MODEL,
         enable_prefix_caching=True,
         block_size=BLOCK_SIZE,
+        kv_transfer_config=kv_transfer_config,
     )
     shared = "You are a helpful assistant. " * 200
     prompts = [shared + q for q in ("What is JAX?", "What is a TPU?")]

@@ -172,6 +172,7 @@ class OffloadEngine:
     def __init__(self, n_threads: int = 4, numa_node: int = -1) -> None:
         self._lib = get_library()
         self._closed = False
+        self.n_threads = n_threads
         self._buffers_lock = threading.Lock()
         # Keep buffer references alive until their job is harvested.
         self._live_buffers: Dict[int, list] = {}

@@ -22,6 +22,7 @@ from llm_d_kv_cache_manager_tpu.offload.file_mapper import FileMapper
 from llm_d_kv_cache_manager_tpu.offload.manager import (
     SharedStorageOffloadManager,
 )
+from llm_d_kv_cache_manager_tpu.offload.staging import StagingBudget
 from llm_d_kv_cache_manager_tpu.offload.worker import (
     DeviceToStorageHandler,
     StorageToDeviceHandler,
@@ -45,6 +46,11 @@ class TPUOffloadSpec:
     # Host-DRAM tier budget; 0 disables the middle tier and offload
     # goes straight to shared storage (docs/architecture.md ladder).
     host_cache_bytes: int = 0
+    # Cap on in-flight host staging bytes across both transfer
+    # directions (reference clamps I/O threads against the same budget,
+    # llmd_fs_backend/worker.py:191-216); submissions block until
+    # completions free room.
+    max_staging_memory_gb: float = 150.0
     dtype: str = "bfloat16"
     tp_size: int = 1
     pp_size: int = 1
@@ -112,6 +118,9 @@ class TPUOffloadConnector:
         self.engine = OffloadEngine(
             n_threads=spec.threads_per_chip, numa_node=spec.numa_node
         )
+        self.staging_budget = StagingBudget(
+            int(spec.max_staging_memory_gb * (1 << 30))
+        )
         self.host_cache = None
         if spec.host_cache_bytes > 0:
             from llm_d_kv_cache_manager_tpu.offload.host_tier import (
@@ -125,9 +134,14 @@ class TPUOffloadConnector:
             self.file_mapper,
             event_sink=event_sink,
             host_cache=self.host_cache,
+            staging_budget=self.staging_budget,
         )
         self.load_handler = StorageToDeviceHandler(
-            pool, self.engine, self.file_mapper, host_cache=self.host_cache
+            pool,
+            self.engine,
+            self.file_mapper,
+            host_cache=self.host_cache,
+            staging_budget=self.staging_budget,
         )
 
     def get_manager(self) -> SharedStorageOffloadManager:

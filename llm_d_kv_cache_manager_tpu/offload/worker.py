@@ -112,10 +112,27 @@ class _HandlerBase:
         pool: KVCachePool,
         engine: OffloadEngine,
         file_mapper: FileMapper,
+        staging_budget=None,
     ) -> None:
         self.pool = pool
         self.engine = engine
         self.file_mapper = file_mapper
+        # Optional in-flight host-byte gate (offload/staging.py); job
+        # bytes are acquired before buffers exist and released at
+        # completion, success or not.
+        self._budget = staging_budget
+        self._budget_bytes: Dict[int, int] = {}
+
+    def _budget_acquire(self, job_id: int, nbytes: int) -> None:
+        if self._budget is not None and nbytes > 0:
+            self._budget.acquire(nbytes)
+            self._budget_bytes[job_id] = nbytes
+
+    def _budget_release(self, job_id: int) -> None:
+        if self._budget is not None:
+            nbytes = self._budget_bytes.pop(job_id, 0)
+            if nbytes:
+                self._budget.release(nbytes)
 
     def owns(self, job_id: int) -> bool:
         raise NotImplementedError
@@ -141,8 +158,9 @@ class DeviceToStorageHandler(_HandlerBase):
         *args,
         event_sink: Optional[StoreEventSink] = None,
         host_cache=None,
+        staging_budget=None,
     ):
-        super().__init__(*args)
+        super().__init__(*args, staging_budget=staging_budget)
         self._event_sink = event_sink
         self._host_cache = host_cache
         # job_id -> (file hashes, payload bytes) until completion.
@@ -154,6 +172,10 @@ class DeviceToStorageHandler(_HandlerBase):
         all_ids: List[int] = []
         for _, ids in groups:
             all_ids.extend(ids)
+        # Gate on the staging budget before the gather allocates.
+        self._budget_acquire(
+            job_id, len(all_ids) * self.pool.block_nbytes
+        )
         # One gather + one DMA for the whole job.
         host = self.pool.gather_to_host(all_ids)  # [L, n, 2, bs, h, d]
 
@@ -186,6 +208,7 @@ class DeviceToStorageHandler(_HandlerBase):
         return job_id in self._job_hashes
 
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
+        self._budget_release(job_id)
         hashes, nbytes = self._job_hashes.pop(job_id, (None, 0))
         METRICS.offload_jobs.labels("store", status.name.lower()).inc()
         if status != JobStatus.SUCCEEDED:
@@ -204,8 +227,8 @@ class StorageToDeviceHandler(_HandlerBase):
     With a ``host_cache``, resident groups are served from host DRAM
     (memcpy, no file I/O); only the cache misses go to the engine."""
 
-    def __init__(self, *args, host_cache=None):
-        super().__init__(*args)
+    def __init__(self, *args, host_cache=None, staging_budget=None):
+        super().__init__(*args, staging_budget=staging_budget)
         self._host_cache = host_cache
         # job_id -> (device_block_ids, host buffers awaiting scatter)
         self._pending: Dict[int, Tuple[List[int], List[np.ndarray]]] = {}
@@ -214,6 +237,8 @@ class StorageToDeviceHandler(_HandlerBase):
         self, job_id: int, groups: Sequence[FileBlockGroup]
     ) -> None:
         c = self.pool.config
+        n_blocks = sum(len(ids) for _, ids in groups)
+        self._budget_acquire(job_id, n_blocks * self.pool.block_nbytes)
         paths: List[str] = []
         buffers: List[np.ndarray] = []
         file_buffers: List[np.ndarray] = []
@@ -252,6 +277,7 @@ class StorageToDeviceHandler(_HandlerBase):
         return job_id in self._pending
 
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
+        self._budget_release(job_id)
         pending = self._pending.pop(job_id, None)
         METRICS.offload_jobs.labels("load", status.name.lower()).inc()
         if pending is None or status != JobStatus.SUCCEEDED:

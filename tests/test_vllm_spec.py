@@ -1,0 +1,462 @@
+"""vLLM OffloadingSpec adapter: layout inference, roundtrip, budget.
+
+vLLM itself is not installed in this image; these tests drive the
+adapter through duck-typed stand-ins for vLLM's config objects and
+attention backends, covering the reference's three KV layouts
+(kv_connectors/llmd_fs_backend/llmd_fs_backend/worker.py:270-346) and
+the staging-memory bound (worker.py:191-216).
+"""
+
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+import pytest
+
+from llm_d_kv_cache_manager_tpu.offload import vllm_spec
+from llm_d_kv_cache_manager_tpu.offload.staging import StagingBudget
+from llm_d_kv_cache_manager_tpu.offload.vllm_spec import (
+    GPULoadStoreSpec,
+    TPUSharedStorageLoadStoreSpec,
+    TPUSharedStorageOffloadingSpec,
+    infer_kv_tensor_views,
+)
+
+# --- vLLM config stand-ins -------------------------------------------------
+
+
+@dataclass
+class CacheConfig:
+    block_size: int = 16
+    cache_dtype: str = "auto"
+
+
+@dataclass
+class ModelConfig:
+    model: str = "test/model"
+    dtype: str = "float32"
+
+
+@dataclass
+class ParallelConfig:
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    prefill_context_parallel_size: int = 1
+    rank: int = 0
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.tensor_parallel_size
+            * self.pipeline_parallel_size
+            * self.prefill_context_parallel_size
+        )
+
+
+@dataclass
+class KVTransferConfig:
+    kv_connector_extra_config: dict = field(default_factory=dict)
+
+
+@dataclass
+class VllmConfig:
+    cache_config: CacheConfig = field(default_factory=CacheConfig)
+    model_config: ModelConfig = field(default_factory=ModelConfig)
+    parallel_config: ParallelConfig = field(default_factory=ParallelConfig)
+    kv_transfer_config: KVTransferConfig = field(
+        default_factory=KVTransferConfig
+    )
+
+
+# --- attention-backend stand-ins ------------------------------------------
+
+
+class StandardBackend:
+    """vLLM FlashAttention-style: (num_blocks, block_size, heads, head)."""
+
+    @staticmethod
+    def get_kv_cache_shape(num_blocks, block_size, num_kv_heads, head_size):
+        return (num_blocks, block_size, num_kv_heads, head_size)
+
+
+class SplitKVBackend:
+    """(2, num_blocks, heads, block_size, head_size) — K/V split."""
+
+    @staticmethod
+    def get_kv_cache_shape(num_blocks, block_size, num_kv_heads, head_size):
+        return (2, num_blocks, num_kv_heads, block_size, head_size)
+
+
+class CrossLayerBackend(StandardBackend):
+    """Per-layer shape; the live tensor carries an extra layer dimension
+    and its stride order puts num_blocks ahead of the layer stack
+    (physical layout ``(num_blocks, L, block_size, heads, head)``)."""
+
+    @staticmethod
+    def get_kv_cache_stride_order(include_num_layers_dimension=False):
+        if include_num_layers_dimension:
+            return (1, 0, 2, 3, 4)
+        return (0, 1, 2, 3)
+
+
+class StrideOrderBackend:
+    """Backend whose canonical order permutes block_size elsewhere."""
+
+    @staticmethod
+    def get_kv_cache_shape(num_blocks, block_size, num_kv_heads, head_size):
+        return (num_blocks, num_kv_heads, block_size, head_size)
+
+    @staticmethod
+    def get_kv_cache_stride_order(include_num_layers_dimension=False):
+        assert not include_num_layers_dimension
+        return (0, 2, 1, 3)  # heads and block_size swapped in memory
+
+
+def spec_for(tmp_path, extra=None, block_size=16):
+    config = VllmConfig(
+        cache_config=CacheConfig(block_size=block_size),
+        kv_transfer_config=KVTransferConfig(
+            {
+                "shared_storage_path": str(tmp_path / "kv"),
+                **(extra or {}),
+            }
+        ),
+    )
+    kv_cache_config = object()
+    return TPUSharedStorageOffloadingSpec(config, kv_cache_config)
+
+
+# --- layout inference ------------------------------------------------------
+
+
+class TestLayoutInference:
+    def test_standard_layout(self):
+        caches = {
+            "l0": np.zeros((8, 16, 2, 4), np.float32),
+            "l1": np.zeros((8, 16, 2, 4), np.float32),
+        }
+        backends = {"l0": StandardBackend, "l1": StandardBackend}
+        views, kernel_bs = infer_kv_tensor_views(caches, backends)
+        assert len(views) == 2 and kernel_bs == 16
+
+    def test_split_kv_layout_doubles_views(self):
+        caches = {"l0": np.zeros((2, 8, 2, 16, 4), np.float32)}
+        views, kernel_bs = infer_kv_tensor_views(
+            caches, {"l0": SplitKVBackend}
+        )
+        assert len(views) == 2 and kernel_bs == 16
+        assert views[0].name == "l0.k" and views[1].name == "l0.v"
+        # Views alias the parent K/V halves.
+        views[0].tensor[0, 0, 0, 0] = 7.0
+        assert caches["l0"][0, 0, 0, 0, 0] == 7.0
+
+    def test_cross_layer_layout(self):
+        # Physical (num_blocks=8, L=4, bs=16, H=2, D=4): blocks lead, so
+        # one view covers all layers of a block (reference "Case 1").
+        caches = {"all": np.zeros((8, 4, 16, 2, 4), np.float32)}
+        views, kernel_bs = infer_kv_tensor_views(
+            caches, {"all": CrossLayerBackend}
+        )
+        assert len(views) == 1 and kernel_bs == 16
+
+    def test_stride_order_locates_block_size(self):
+        # Canonical (nb, heads, bs, hs); stride order (0,2,1,3) says the
+        # physical layout is (nb, bs, heads, hs).
+        caches = {"l0": np.zeros((8, 16, 2, 4), np.float32)}
+        views, kernel_bs = infer_kv_tensor_views(
+            caches, {"l0": StrideOrderBackend}
+        )
+        assert kernel_bs == 16
+
+    def test_mismatched_kernel_block_size_rejected(self):
+        caches = {
+            "l0": np.zeros((8, 16, 2, 4), np.float32),
+            "l1": np.zeros((8, 8, 2, 4), np.float32),
+        }
+        with pytest.raises(ValueError, match="kernel block size"):
+            infer_kv_tensor_views(
+                caches, {"l0": StandardBackend, "l1": StandardBackend}
+            )
+
+    def test_unrecognized_rank_rejected(self):
+        caches = {"l0": np.zeros((8, 16, 2, 4, 1, 1), np.float32)}
+        with pytest.raises(ValueError, match="rank"):
+            infer_kv_tensor_views(caches, {"l0": StandardBackend})
+
+
+# --- spec construction -----------------------------------------------------
+
+
+class TestSpecConstruction:
+    def test_importable_without_vllm(self):
+        assert vllm_spec.HAVE_VLLM is False  # this image has no vLLM
+
+    def test_reads_extra_config(self, tmp_path):
+        spec = spec_for(
+            tmp_path,
+            extra={"block_size": 64, "threads_per_chip": 2,
+                   "max_staging_memory_gb": 1},
+        )
+        assert spec.blocks_per_file == 4
+        assert spec.threads_per_chip == 2
+        assert spec.max_staging_memory_gb == 1
+        assert "test/model" in spec.file_mapper.get_file_name(0xABC)
+
+    def test_rejects_misaligned_block_size(self, tmp_path):
+        with pytest.raises(ValueError, match="multiple"):
+            spec_for(tmp_path, extra={"block_size": 24})
+
+    def test_rejects_world_size_mismatch(self, tmp_path):
+        config = VllmConfig(
+            parallel_config=ParallelConfig(tensor_parallel_size=2)
+        )
+        config.parallel_config.__class__ = type(
+            "P", (), {"world_size": 3, **{
+                k: getattr(config.parallel_config, k)
+                for k in ("tensor_parallel_size", "pipeline_parallel_size",
+                          "prefill_context_parallel_size", "rank")
+            }}
+        )
+        with pytest.raises(ValueError, match="world_size"):
+            TPUSharedStorageOffloadingSpec(config, object())
+
+    def test_manager_rank0_only(self, tmp_path):
+        spec = spec_for(tmp_path)
+        spec.vllm_config.parallel_config.rank = 1
+        with pytest.raises(RuntimeError, match="rank 0"):
+            spec.get_manager()
+
+
+# --- end-to-end roundtrip --------------------------------------------------
+
+
+def run_roundtrip(tmp_path, caches, backends, n_blocks, extra=None):
+    spec = spec_for(tmp_path, extra=extra)
+    handlers = list(spec.get_handlers(caches, backends))
+    (_, _, store), (_, _, load) = handlers
+    assert handlers[0][0] is GPULoadStoreSpec
+    assert handlers[0][1] is TPUSharedStorageLoadStoreSpec
+
+    block_ids = list(range(n_blocks))
+    bpf = spec.blocks_per_file
+    n_files = -(-n_blocks // bpf)
+    hashes = [0x1000 + i for i in range(n_files)]
+
+    originals = {k: np.array(v, copy=True) for k, v in caches.items()}
+    assert store.transfer_async(
+        1, (GPULoadStoreSpec(block_ids), TPUSharedStorageLoadStoreSpec(hashes))
+    )
+    store.wait({1})
+
+    manager = spec.get_manager()
+    assert manager.lookup(hashes) == len(hashes)
+
+    for cache in caches.values():
+        cache[...] = 0
+    assert load.transfer_async(
+        2, (TPUSharedStorageLoadStoreSpec(hashes), GPULoadStoreSpec(block_ids))
+    )
+    load.wait({2})
+    for name, cache in caches.items():
+        np.testing.assert_array_equal(cache, originals[name], err_msg=name)
+    return spec
+
+
+class TestRoundtrip:
+    def test_standard_two_layers(self, tmp_path):
+        rng = np.random.default_rng(0)
+        caches = {
+            f"l{i}": rng.standard_normal((12, 16, 2, 4)).astype(np.float32)
+            for i in range(2)
+        }
+        backends = {f"l{i}": StandardBackend for i in range(2)}
+        run_roundtrip(tmp_path, caches, backends, n_blocks=12,
+                      extra={"block_size": 64})
+
+    def test_split_kv_partial_first_group(self, tmp_path):
+        rng = np.random.default_rng(1)
+        caches = {
+            "l0": rng.standard_normal((2, 10, 2, 16, 4)).astype(np.float32)
+        }
+        # 10 blocks over bpf=4 -> first file partial (2 blocks), 2 full.
+        run_roundtrip(tmp_path, caches, {"l0": SplitKVBackend}, n_blocks=10,
+                      extra={"block_size": 64})
+
+    def test_kernel_blocks_smaller_than_device_blocks(self, tmp_path):
+        rng = np.random.default_rng(2)
+        # kernel block 8, device block 16 -> 2 kernel blocks per block.
+        caches = {
+            "l0": rng.standard_normal((24, 8, 2, 4)).astype(np.float32)
+        }
+        run_roundtrip(tmp_path, caches, {"l0": StandardBackend}, n_blocks=12,
+                      extra={"block_size": 32})
+
+    def test_cross_layer_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(5)
+        caches = {
+            "all": rng.standard_normal((12, 4, 16, 2, 4)).astype(np.float32)
+        }
+        run_roundtrip(tmp_path, caches, {"all": CrossLayerBackend},
+                      n_blocks=12, extra={"block_size": 64})
+
+    def test_torch_bfloat16_bit_exact(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        caches_t = {
+            "l0": torch.randn(8, 16, 2, 4, dtype=torch.float32).to(
+                torch.bfloat16
+            )
+        }
+        spec = spec_for(tmp_path, extra={"block_size": 64})
+        (_, _, store), (_, _, load) = spec.get_handlers(
+            caches_t, {"l0": StandardBackend}
+        )
+        original = caches_t["l0"].clone()
+        ids = list(range(8))
+        store.transfer_async(
+            1, (GPULoadStoreSpec(ids), TPUSharedStorageLoadStoreSpec([1, 2]))
+        )
+        store.wait({1})
+        caches_t["l0"].zero_()
+        load.transfer_async(
+            2, (TPUSharedStorageLoadStoreSpec([1, 2]), GPULoadStoreSpec(ids))
+        )
+        load.wait({2})
+        assert torch.equal(caches_t["l0"], original)
+
+    def test_get_finished_reports_and_scatters(self, tmp_path):
+        rng = np.random.default_rng(3)
+        caches = {
+            "l0": rng.standard_normal((8, 16, 2, 4)).astype(np.float32)
+        }
+        spec = spec_for(tmp_path, extra={"block_size": 64})
+        (_, _, store), (_, _, load) = spec.get_handlers(
+            caches, {"l0": StandardBackend}
+        )
+        original = caches["l0"].copy()
+        ids = list(range(8))
+        store.transfer_async(
+            7, (GPULoadStoreSpec(ids), TPUSharedStorageLoadStoreSpec([5, 6]))
+        )
+        done = []
+        while not done:
+            done = store.get_finished()
+        assert done == [(7, True)]
+        caches["l0"][...] = 0
+        load.transfer_async(
+            8, (TPUSharedStorageLoadStoreSpec([5, 6]), GPULoadStoreSpec(ids))
+        )
+        done = []
+        while not done:
+            done = load.get_finished()
+        assert done == [(8, True)]
+        np.testing.assert_array_equal(caches["l0"], original)
+
+    def test_missing_file_load_fails(self, tmp_path):
+        caches = {"l0": np.zeros((8, 16, 2, 4), np.float32)}
+        spec = spec_for(tmp_path, extra={"block_size": 64})
+        (_, _, _store), (_, _, load) = spec.get_handlers(
+            caches, {"l0": StandardBackend}
+        )
+        load.transfer_async(
+            9,
+            (
+                TPUSharedStorageLoadStoreSpec([0xDEAD]),
+                GPULoadStoreSpec(list(range(4))),
+            ),
+        )
+        done = []
+        while not done:
+            done = load.get_finished()
+        assert done == [(9, False)]
+
+
+# --- staging budget --------------------------------------------------------
+
+
+class TestStagingBudget:
+    def test_acquire_release(self):
+        budget = StagingBudget(100)
+        assert budget.acquire(60)
+        assert not budget.acquire(60, timeout=0.05)
+        budget.release(60)
+        assert budget.acquire(60)
+
+    def test_oversized_request_admitted_alone(self):
+        budget = StagingBudget(10)
+        assert budget.acquire(50)  # would deadlock if refused forever
+        assert not budget.acquire(1, timeout=0.05)
+        budget.release(50)
+        assert budget.acquire(1)
+
+    def test_burst_never_exceeds_budget(self, tmp_path):
+        """A burst of stores from many threads must keep in-flight host
+        bytes within max_staging_memory_gb at every sampled instant."""
+        rng = np.random.default_rng(4)
+        caches = {
+            "l0": rng.standard_normal((64, 16, 2, 4)).astype(np.float32)
+        }
+        spec = spec_for(
+            tmp_path,
+            # Tiny budget: one file buffer is 16KB; budget fits ~2.
+            extra={"block_size": 64, "max_staging_memory_gb": 32 / (1 << 20)},
+        )
+        (_, _, store), _ = spec.get_handlers(caches, {"l0": StandardBackend})
+        budget = store.budget
+        assert budget.max_bytes == 32 * 1024
+
+        violations = []
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                seen = budget.in_flight_bytes
+                if seen > budget.max_bytes:
+                    violations.append(seen)
+
+        sampler_thread = threading.Thread(target=sampler)
+        sampler_thread.start()
+
+        def submit(job_id):
+            ids = list(range(16))
+            hashes = [job_id * 100 + i for i in range(4)]
+            store.transfer_async(
+                job_id,
+                (
+                    GPULoadStoreSpec(ids),
+                    TPUSharedStorageLoadStoreSpec(hashes),
+                ),
+            )
+
+        threads = [
+            threading.Thread(target=submit, args=(j,)) for j in range(1, 9)
+        ]
+        for t in threads:
+            t.start()
+        deadline_jobs = set(range(1, 9))
+        finished = set()
+        while finished != deadline_jobs:
+            for job_id, ok in store.get_finished():
+                assert ok
+                finished.add(job_id)
+        for t in threads:
+            t.join(timeout=10)
+        stop.set()
+        sampler_thread.join(timeout=5)
+        assert not violations
+        assert budget.in_flight_bytes == 0
+
+    def test_thread_clamp_under_budget(self, tmp_path):
+        caches = {
+            "l0": np.zeros((64, 16, 2, 4), np.float32)
+        }
+        spec = spec_for(
+            tmp_path,
+            extra={
+                "block_size": 64,
+                "threads_per_chip": 32,
+                # Budget ~= one 16KB file buffer: threads must clamp to 1.
+                "max_staging_memory_gb": 16 / (1 << 20),
+            },
+        )
+        (_, _, store), _ = spec.get_handlers(caches, {"l0": StandardBackend})
+        assert store.engine.n_threads == 1
