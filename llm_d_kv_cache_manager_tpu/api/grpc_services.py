@@ -8,6 +8,9 @@ and Python code.
 
 from __future__ import annotations
 
+import os
+from typing import Optional
+
 import grpc
 
 from llm_d_kv_cache_manager_tpu.api import indexer_pb2, tokenizer_pb2
@@ -160,26 +163,42 @@ def value_to_python(value: tokenizer_pb2.Value):
     return None
 
 
-def python_to_value(obj) -> tokenizer_pb2.Value:
+# int_value (field 6) is an extension over the reference proto, whose
+# Value oneof stops at number_value (api/tokenizerpb/tokenizer.proto).
+# A reference Go sidecar receiving int_value leaves the oneof unset and
+# the kwarg silently becomes null — so when talking to a peer that may
+# run the reference implementation, disable the extension and fall back
+# to the reference's lossy-float encoding.  Env toggle for deployments;
+# per-call override for tests.
+USE_INT_VALUE = os.environ.get("KVTPU_PROTO_INT_VALUE", "1") != "0"
+
+
+def python_to_value(
+    obj, use_int_value: Optional[bool] = None
+) -> tokenizer_pb2.Value:
+    if use_int_value is None:
+        use_int_value = USE_INT_VALUE
     value = tokenizer_pb2.Value()
     if isinstance(obj, bool):
         value.bool_value = obj
     elif isinstance(obj, str):
         value.string_value = obj
     elif isinstance(obj, int):
-        if -(2**63) <= obj < 2**63:
+        if use_int_value and -(2**63) <= obj < 2**63:
             value.int_value = obj
-        else:  # beyond sint64: lossy float, as the old encoding was
+        else:  # beyond sint64 (or reference-compat mode): lossy float
             value.number_value = float(obj)
     elif isinstance(obj, float):
         value.number_value = obj
     elif isinstance(obj, (list, tuple)):
-        value.list_value.values.extend(python_to_value(item) for item in obj)
+        value.list_value.values.extend(
+            python_to_value(item, use_int_value) for item in obj
+        )
     elif isinstance(obj, dict):
         value.struct_value.SetInParent()
         for key, item in obj.items():
             value.struct_value.fields[str(key)].CopyFrom(
-                python_to_value(item)
+                python_to_value(item, use_int_value)
             )
     elif obj is None:
         pass  # unset oneof round-trips as None in value_to_python

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import ssl
 import threading
 import urllib.error
@@ -232,9 +233,11 @@ class PodReconciler:
                 if obj.get("kind") not in (None, "Pod"):
                     continue
                 self.reconcile(kind, obj)
-        except TimeoutError:
+        except (TimeoutError, socket.timeout):
             # Dead (half-open) stream: treat like a normal stream end and
-            # let the loop re-list.
+            # let the loop re-list.  socket.timeout is only an alias of
+            # TimeoutError from Python 3.10; catch both so older
+            # interpreters get the quiet re-list too.
             logger.info("watch read timed out; re-listing")
 
     def _loop(self) -> None:

@@ -60,6 +60,18 @@ class StagingBudget:
             self._in_flight += nbytes
             return True
 
+    def try_acquire(self, nbytes: int) -> bool:
+        """Non-blocking acquire: True iff the bytes fit right now.
+
+        Submission paths that run on a serving thread must use this
+        instead of ``acquire``: when releases can only happen via later
+        calls on the *same* thread (e.g. vLLM's worker polls
+        ``get_finished`` between ``transfer_async`` calls), a blocking
+        acquire deadlocks the serving loop once in-flight bytes reach
+        the budget.
+        """
+        return self.acquire(nbytes, timeout=0)
+
     def release(self, nbytes: int) -> None:
         with self._cond:
             self._in_flight -= nbytes

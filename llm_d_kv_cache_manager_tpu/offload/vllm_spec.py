@@ -38,6 +38,7 @@ jax-native connector, whose tail-partial deviation documents why
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -314,11 +315,53 @@ def build_file_block_mapping(
     return files, per_file
 
 
+class CompletionRouter:
+    """Routes a shared engine's completions to the owning handler.
+
+    vLLM's ``OffloadingWorker`` polls ``get_finished`` on *every*
+    handler, but the engine is shared by both directions — an unfiltered
+    drain would let the store handler consume a load job's completion,
+    so the load's harvest-time scatter never runs (silent KV corruption)
+    and its staging bytes leak.  The in-repo jax-native connector routes
+    via ``owns()``/``on_finished`` (offload/spec.py) for the same
+    reason; this router is the vLLM-adapter equivalent: completions not
+    owned by the draining handler are buffered until their owner drains.
+    """
+
+    def __init__(self, engine: OffloadEngine) -> None:
+        self.engine = engine
+        self._unclaimed: Dict[int, JobStatus] = {}
+        self._lock = threading.Lock()
+
+    def drain(self, owned_ids) -> List[Tuple[int, JobStatus]]:
+        """Harvest engine completions; return only those in ``owned_ids``."""
+        with self._lock:
+            for job_id, status in self.engine.get_finished():
+                self._unclaimed[job_id] = status
+            mine = [j for j in list(self._unclaimed) if j in owned_ids]
+            return [(j, self._unclaimed.pop(j)) for j in mine]
+
+    def wait_for(self, job_id: int) -> JobStatus:
+        """Block until ``job_id`` completes, wherever it was harvested.
+
+        Held under the router lock so a completion can never sit
+        popped-from-the-engine but not-yet-buffered while a waiter looks
+        for it.  vLLM drives both handlers from one worker thread, so
+        the lock is uncontended in practice.
+        """
+        with self._lock:
+            if job_id in self._unclaimed:
+                return self._unclaimed.pop(job_id)
+            return self.engine.wait(job_id)
+
+
 class _VllmHandlerBase(_OffloadingHandler):
     """Gathers/scatters whole device blocks through the native engine.
 
-    One engine and one staging budget are shared by both directions; each
-    handler tracks its own job ids so completions route correctly.
+    One engine and one staging budget are shared by both directions; the
+    :class:`CompletionRouter` keys completions to the handler whose
+    ``_job_bytes`` holds the job id, so each direction's ``_finish``
+    (budget release, and the load path's scatter) always runs.
     """
 
     def __init__(
@@ -329,6 +372,7 @@ class _VllmHandlerBase(_OffloadingHandler):
         file_mapper: FileMapper,
         engine: OffloadEngine,
         budget: StagingBudget,
+        router: CompletionRouter,
     ) -> None:
         self.views = views
         self.kernel_blocks_per_block = kernel_blocks_per_block
@@ -336,6 +380,9 @@ class _VllmHandlerBase(_OffloadingHandler):
         self.file_mapper = file_mapper
         self.engine = engine
         self.budget = budget
+        # Required, never defaulted: two handlers on one engine with
+        # separate routers would strand each other's completions.
+        self.router = router
         self._job_bytes: Dict[int, int] = {}
         # Probe once: host dtype and per-kernel-block element count.
         probe = views[0].read([0])
@@ -358,15 +405,24 @@ class _VllmHandlerBase(_OffloadingHandler):
             self.kernel_block_elems,
         )
 
+    def _job_nbytes(self, per_file: Sequence[Sequence[int]]) -> int:
+        """Host bytes a job's file buffers will occupy (shape-derived, so
+        it can be charged to the budget BEFORE any allocation)."""
+        total = sum(
+            int(np.prod(self._file_buffer_shape(len(ids))))
+            for ids in per_file
+        )
+        return total * self.host_dtype.itemsize
+
     def get_finished(self) -> List[Tuple[int, bool]]:
         out = []
-        for job_id, status in self.engine.get_finished():
+        for job_id, status in self.router.drain(self._job_bytes):
             out.append((job_id, self._finish(job_id, status)))
         return out
 
     def wait(self, job_ids) -> None:
         for job_id in set(job_ids):
-            self._finish(job_id, self.engine.wait(job_id))
+            self._finish(job_id, self.router.wait_for(job_id))
 
     def _finish(self, job_id: int, status: JobStatus) -> bool:
         nbytes = self._job_bytes.pop(job_id, 0)
@@ -386,12 +442,12 @@ class TPUToStorageHandler(_VllmHandlerBase):
             list(src.block_ids),
             self.blocks_per_file,
         )
-        total = sum(
-            int(np.prod(self._file_buffer_shape(len(ids))))
-            for ids in per_file
-        )
-        nbytes = total * self.host_dtype.itemsize
-        self.budget.acquire(nbytes)
+        nbytes = self._job_nbytes(per_file)
+        # Non-blocking: releases happen when this same vLLM worker thread
+        # later polls get_finished, so blocking here would deadlock the
+        # serving loop.  False tells vLLM to retry the transfer later.
+        if not self.budget.try_acquire(nbytes):
+            return False
         buffers = []
         for ids in per_file:
             stacked = np.stack(
@@ -432,12 +488,18 @@ class StorageToTPUHandler(_VllmHandlerBase):
             list(dst.block_ids),
             self.blocks_per_file,
         )
+        # Acquire BEFORE allocating (mirrors the store path): a submitter
+        # blocked-out by the budget must not already hold its job's host
+        # memory, or the gate no longer bounds resident bytes.  And
+        # non-blocking, for the same serving-loop-deadlock reason as the
+        # store path.
+        nbytes = self._job_nbytes(per_file)
+        if not self.budget.try_acquire(nbytes):
+            return False
         buffers = [
             np.empty(self._file_buffer_shape(len(ids)), dtype=self.host_dtype)
             for ids in per_file
         ]
-        nbytes = sum(buffer.nbytes for buffer in buffers)
-        self.budget.acquire(nbytes)
         self._job_bytes[job_id] = nbytes
         self._pending[job_id] = (per_file, buffers)
         self.engine.load(job_id, files, buffers)
@@ -631,6 +693,7 @@ class TPUSharedStorageOffloadingSpec(_OffloadingSpec):
             )
         engine = OffloadEngine(n_threads=int(threads))
         budget = StagingBudget(budget_bytes)
+        router = CompletionRouter(engine)  # shared: one drain point
         common = (
             views,
             kernel_per_block,
@@ -638,6 +701,7 @@ class TPUSharedStorageOffloadingSpec(_OffloadingSpec):
             self.file_mapper,
             engine,
             budget,
+            router,
         )
         logger.info(
             "vLLM offload handlers: %d views, kernel_bs=%d, "
