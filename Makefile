@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test unit-test e2e-test examples bench native proto graft-check clean
+.PHONY: all test unit-test e2e-test examples bench native proto graft-check chart clean
 
 all: native test
 
@@ -22,6 +22,15 @@ examples:
 # Fleet-routing benchmark; on TPU hardware drop JAX_PLATFORMS.
 bench:
 	$(PYTHON) bench.py
+
+# Render the serving-fleet chart: real helm when installed, the
+# subset renderer otherwise (same sources, same output).
+chart:
+	@if command -v helm >/dev/null 2>&1; then \
+		helm template kvtpu deploy/chart; \
+	else \
+		$(PYTHON) hack/render_chart.py deploy/chart; \
+	fi
 
 # Build the native C++ engine in-tree.
 native:

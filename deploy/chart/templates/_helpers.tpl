@@ -1,0 +1,47 @@
+{{/* Common labels. */}}
+{{- define "fleet.labels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
+
+{{/* Namespace: always the release namespace — identical under real
+helm (which sets it from -n, default "default") and the subset
+renderer, so `make chart` output can't diverge between the two. */}}
+{{- define "fleet.namespace" -}}
+{{- .Release.Namespace -}}
+{{- end }}
+
+{{/* vLLM workload name. */}}
+{{- define "fleet.vllmName" -}}
+{{- .Release.Name }}-vllm-{{ .Values.vllm.model.label | lower -}}
+{{- end }}
+
+{{/* Indexer workload/service name. */}}
+{{- define "fleet.indexerName" -}}
+{{- .Release.Name }}-kv-cache-indexer
+{{- end }}
+
+{{/* Valkey service name. */}}
+{{- define "fleet.valkeyName" -}}
+{{- .Release.Name }}-valkey
+{{- end }}
+
+{{/* Shared-storage PVC name (honors existingClaim). */}}
+{{- define "fleet.sharedClaim" -}}
+{{- if .Values.sharedStorage.existingClaim -}}
+{{- .Values.sharedStorage.existingClaim -}}
+{{- else -}}
+{{- .Release.Name }}-shared-kv
+{{- end -}}
+{{- end }}
+
+{{/* ZMQ endpoint the indexer binds in central (non-discovery) mode. */}}
+{{- define "fleet.centralZmqUrl" -}}
+tcp://{{ include "fleet.indexerName" . }}.{{ include "fleet.namespace" . }}.svc.cluster.local:{{ .Values.events.port -}}
+{{- end }}
+
+{{/* Valkey index-backend URL for the indexer. */}}
+{{- define "fleet.valkeyUrl" -}}
+valkey://{{ include "fleet.valkeyName" . }}.{{ include "fleet.namespace" . }}.svc.cluster.local:{{ .Values.valkey.port -}}
+{{- end }}

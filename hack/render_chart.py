@@ -1,0 +1,419 @@
+#!/usr/bin/env python3
+"""Render the deploy/chart Helm chart without helm.
+
+A deliberate *subset* of Helm's template language — enough for this
+chart, no more: ``{{ .Values.* }}`` / ``.Release.*`` / ``.Chart.*``
+paths, ``if`` / ``else if`` / ``else`` / ``end``, ``define`` /
+``include``, and the pipeline functions ``quote squote lower upper
+default toYaml nindent indent trim printf eq ne and or not int``.
+The chart's templates are written to stay inside this subset, so the
+same sources render identically under real ``helm template`` (use that
+in clusters where helm is available) and under this script (CI here has
+no helm binary; tests render through this and assert the fleet's
+cross-invariants on the parsed output).
+
+Usage:
+    python hack/render_chart.py deploy/chart [--set a.b.c=value ...] \
+        [--release NAME] [--namespace NS]
+
+Prints a multi-document YAML stream, like ``helm template``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+try:
+    import yaml
+except ImportError as exc:  # pragma: no cover
+    raise SystemExit("render_chart.py needs PyYAML") from exc
+
+_TOKEN = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.DOTALL)
+
+
+# --- template parsing ------------------------------------------------------
+
+
+class Node:
+    pass
+
+
+class Text(Node):
+    def __init__(self, text: str) -> None:
+        self.text = text
+
+
+class Action(Node):
+    def __init__(self, expr: str) -> None:
+        self.expr = expr
+
+
+class If(Node):
+    def __init__(self) -> None:
+        # [(condition-expr or None for else, body nodes)]
+        self.branches: List[Tuple[Optional[str], List[Node]]] = []
+
+
+class Define(Node):
+    def __init__(self, name: str, body: List[Node]) -> None:
+        self.name = name
+        self.body = body
+
+
+def _lex(source: str) -> List[Tuple[str, str]]:
+    """Split into ('text', s) and ('action', expr) tokens, applying
+    {{- / -}} whitespace trimming to the neighboring text."""
+    tokens: List[Tuple[str, str]] = []
+    pos = 0
+    for match in _TOKEN.finditer(source):
+        text = source[pos : match.start()]
+        raw = match.group(0)
+        if raw.startswith("{{-"):
+            text = text.rstrip(" \t")
+            if text.endswith("\n"):
+                text = text[:-1]
+        tokens.append(("text", text))
+        tokens.append(("action", match.group(1).strip()))
+        pos = match.end()
+        if raw.endswith("-}}"):
+            rest = source[pos:]
+            stripped = rest.lstrip(" \t")
+            if stripped.startswith("\n"):
+                pos += len(rest) - len(stripped) + 1
+            else:
+                pos += len(rest) - len(stripped)
+    tokens.append(("text", source[pos:]))
+    return tokens
+
+
+def _parse(tokens: List[Tuple[str, str]]) -> List[Node]:
+    root: List[Node] = []
+    # stack of (list-to-append-to, open If node or Define marker)
+    stack: List[Tuple[List[Node], Optional[Node]]] = [(root, None)]
+
+    for kind, value in tokens:
+        target = stack[-1][0]
+        if kind == "text":
+            if value:
+                target.append(Text(value))
+            continue
+        expr = value
+        if expr.startswith("/*") or expr.startswith("#"):
+            continue  # comment
+        if expr.startswith("define "):
+            name = expr[len("define ") :].strip().strip('"')
+            body: List[Node] = []
+            node = Define(name, body)
+            stack[-1][0].append(node)
+            stack.append((body, node))
+        elif expr.startswith("if "):
+            node = If()
+            body = []
+            node.branches.append((expr[3:].strip(), body))
+            stack[-1][0].append(node)
+            stack.append((body, node))
+        elif expr.startswith("else if "):
+            body = []
+            _, open_node = stack.pop()
+            if not isinstance(open_node, If):
+                raise ValueError("'else if' outside if")
+            open_node.branches.append((expr[len("else if ") :].strip(), body))
+            stack.append((body, open_node))
+        elif expr == "else":
+            body = []
+            _, open_node = stack.pop()
+            if not isinstance(open_node, If):
+                raise ValueError("'else' outside if")
+            open_node.branches.append((None, body))
+            stack.append((body, open_node))
+        elif expr == "end":
+            stack.pop()
+            if not stack:
+                raise ValueError("unbalanced 'end'")
+        else:
+            target.append(Action(expr))
+    if len(stack) != 1:
+        raise ValueError("unclosed block in template")
+    return root
+
+
+# --- expression evaluation -------------------------------------------------
+
+_SPLIT_ARGS = re.compile(r'"(?:[^"\\]|\\.)*"|\S+')
+
+
+def _truthy(value: Any) -> bool:
+    if value is None or value is False:
+        return False
+    if isinstance(value, (int, float)) and value == 0 and value is not True:
+        return False
+    if isinstance(value, (str, list, dict, tuple)) and len(value) == 0:
+        return False
+    return True
+
+
+def _to_yaml(value: Any) -> str:
+    if value is None:
+        return ""
+    out = yaml.safe_dump(value, default_flow_style=False, sort_keys=False)
+    return out.rstrip("\n")
+
+
+class Renderer:
+    def __init__(self, context: Dict[str, Any]) -> None:
+        self.context = context
+        self.defines: Dict[str, List[Node]] = {}
+
+    # -- value resolution --
+
+    def _resolve_path(self, path: str) -> Any:
+        node: Any = self.context
+        for part in path.lstrip(".").split("."):
+            if not part:
+                continue
+            if isinstance(node, dict):
+                node = node.get(part)
+            else:
+                node = getattr(node, part, None)
+            if node is None:
+                return None
+        return node
+
+    def _atom(self, token: str) -> Any:
+        if token.startswith('"'):
+            return token[1:-1].encode().decode("unicode_escape")
+        if token == ".":
+            return self.context
+        if token.startswith("."):
+            return self._resolve_path(token)
+        if token in ("true", "false"):
+            return token == "true"
+        if token in ("nil", "null"):
+            return None
+        try:
+            return int(token)
+        except ValueError:
+            pass
+        try:
+            return float(token)
+        except ValueError:
+            pass
+        raise ValueError(f"cannot evaluate template atom: {token!r}")
+
+    def _call(self, name: str, args: List[Any]) -> Any:
+        if name == "quote":
+            return '"' + str(args[0]).replace('"', '\\"') + '"'
+        if name == "squote":
+            return "'" + str(args[0]) + "'"
+        if name == "lower":
+            return str(args[0]).lower()
+        if name == "upper":
+            return str(args[0]).upper()
+        if name == "trim":
+            return str(args[0]).strip()
+        if name == "int":
+            return int(float(args[0]))
+        if name == "default":
+            return args[1] if _truthy(args[1]) else args[0]
+        if name == "toYaml":
+            return _to_yaml(args[0])
+        if name == "indent":
+            pad = " " * int(args[0])
+            return "\n".join(pad + line for line in str(args[1]).split("\n"))
+        if name == "nindent":
+            return "\n" + self._call("indent", args)
+        if name == "printf":
+            fmt = str(args[0]).replace("%v", "%s").replace("%d", "%s")
+            return fmt % tuple(str(a) for a in args[1:])
+        if name == "eq":
+            return all(a == args[0] for a in args[1:])
+        if name == "ne":
+            return args[0] != args[1]
+        if name == "gt":
+            return args[0] > args[1]
+        if name == "ge":
+            return args[0] >= args[1]
+        if name == "lt":
+            return args[0] < args[1]
+        if name == "le":
+            return args[0] <= args[1]
+        if name == "and":
+            result: Any = True
+            for arg in args:
+                result = arg
+                if not _truthy(arg):
+                    return arg
+            return result
+        if name == "or":
+            for arg in args:
+                if _truthy(arg):
+                    return arg
+            return args[-1] if args else None
+        if name == "not":
+            return not _truthy(args[0])
+        if name == "fail":
+            raise ValueError(f"chart validation failed: {args[0]}")
+        if name == "include":
+            body = self.defines.get(str(args[0]))
+            if body is None:
+                raise ValueError(f"include of unknown define {args[0]!r}")
+            return self.render_nodes(body)
+        raise ValueError(f"unsupported template function: {name}")
+
+    _FUNCTIONS = {
+        "quote", "squote", "lower", "upper", "trim", "int", "default",
+        "toYaml", "indent", "nindent", "printf", "eq", "ne", "gt",
+        "ge", "lt", "le", "and", "or", "not", "include", "fail",
+    }
+
+    def _command(self, tokens: List[str], piped: Optional[Any]) -> Any:
+        head = tokens[0]
+        if head in self._FUNCTIONS:
+            args = [self._atom(t) for t in tokens[1:]]
+            if piped is not None or (not args and head != "include"):
+                args.append(piped)
+            return self._call(head, args)
+        if len(tokens) != 1 or piped is not None:
+            raise ValueError(f"cannot evaluate: {' '.join(tokens)}")
+        return self._atom(head)
+
+    def evaluate(self, expr: str) -> Any:
+        piped: Optional[Any] = None
+        for i, segment in enumerate(expr.split("|")):
+            tokens = _SPLIT_ARGS.findall(segment.strip())
+            if not tokens:
+                raise ValueError(f"empty pipeline segment in {expr!r}")
+            piped = self._command(tokens, piped if i > 0 else None)
+        return piped
+
+    # -- rendering --
+
+    def collect_defines(self, nodes: List[Node]) -> None:
+        for node in nodes:
+            if isinstance(node, Define):
+                self.defines[node.name] = node.body
+
+    def render_nodes(self, nodes: List[Node]) -> str:
+        out: List[str] = []
+        for node in nodes:
+            if isinstance(node, Text):
+                out.append(node.text)
+            elif isinstance(node, Define):
+                continue
+            elif isinstance(node, If):
+                for condition, body in node.branches:
+                    if condition is None or _truthy(
+                        self.evaluate(condition)
+                    ):
+                        out.append(self.render_nodes(body))
+                        break
+            elif isinstance(node, Action):
+                value = self.evaluate(node.expr)
+                if value is True:
+                    out.append("true")
+                elif value is False:
+                    out.append("false")
+                elif value is not None:
+                    out.append(str(value))
+        return "".join(out)
+
+
+# --- chart assembly --------------------------------------------------------
+
+
+def _set_path(values: dict, dotted: str, raw: str) -> None:
+    node = values
+    parts = dotted.split(".")
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    try:
+        parsed = yaml.safe_load(raw)
+    except yaml.YAMLError:
+        parsed = raw
+    node[parts[-1]] = parsed
+
+
+def render_chart(
+    chart_dir: str,
+    release_name: str = "kvtpu",
+    namespace: Optional[str] = None,
+    set_values: Optional[Dict[str, str]] = None,
+) -> str:
+    """Render every template in the chart; returns one multi-doc YAML
+    string (empty documents dropped, like ``helm template``)."""
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f)
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f) or {}
+    for dotted, raw in (set_values or {}).items():
+        _set_path(values, dotted, raw)
+
+    context = {
+        "Values": values,
+        "Release": {
+            # Same default as real helm without -n, so both renderers
+            # produce identical namespaces from the same sources.
+            "Name": release_name,
+            "Namespace": namespace or "default",
+            "Service": "Helm",
+        },
+        "Chart": {
+            "Name": chart_meta.get("name", "chart"),
+            "Version": chart_meta.get("version", "0"),
+            "AppVersion": chart_meta.get("appVersion", ""),
+        },
+    }
+    renderer = Renderer(context)
+
+    template_dir = os.path.join(chart_dir, "templates")
+    names = sorted(os.listdir(template_dir))
+    for name in names:  # defines first, from every file
+        if name.endswith((".tpl", ".yaml")):
+            with open(os.path.join(template_dir, name)) as f:
+                renderer.collect_defines(_parse(_lex(f.read())))
+
+    documents: List[str] = []
+    for name in names:
+        if not name.endswith(".yaml"):
+            continue
+        with open(os.path.join(template_dir, name)) as f:
+            rendered = renderer.render_nodes(_parse(_lex(f.read())))
+        for doc in rendered.split("\n---"):
+            if yaml.safe_load(doc) is not None:
+                documents.append(doc.strip("\n"))
+    return "\n---\n".join(documents) + "\n"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("chart_dir")
+    parser.add_argument("--release", default="kvtpu")
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument(
+        "--set",
+        dest="sets",
+        action="append",
+        default=[],
+        metavar="a.b.c=value",
+    )
+    args = parser.parse_args()
+    set_values = {}
+    for item in args.sets:
+        key, _, value = item.partition("=")
+        set_values[key] = value
+    sys.stdout.write(
+        render_chart(
+            args.chart_dir,
+            release_name=args.release,
+            namespace=args.namespace,
+            set_values=set_values,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,345 @@
+"""Serving-fleet chart: render + cross-invariant checks.
+
+The chart's job is to make the fleet-wide invariants (hash seed, block
+size, ZMQ port/topic, discovery label, storage path) impossible to
+desynchronize: each is defined once in values.yaml and flows into every
+consumer.  These tests render the chart (hack/render_chart.py — a
+helm-template-compatible subset renderer; real helm renders the same
+sources) and assert the rendered engine and indexer agree, mirroring
+what the reference chart guarantees by construction
+(vllm-setup-helm/templates/deployment.yaml + kv-cache-manager.yaml).
+"""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+import yaml
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "hack"))
+from render_chart import render_chart  # noqa: E402
+
+CHART = os.path.join(os.path.dirname(__file__), "..", "deploy", "chart")
+
+
+def render(**set_values):
+    text = render_chart(CHART, set_values=set_values or None)
+    docs = [d for d in yaml.safe_load_all(text) if d is not None]
+    return docs
+
+
+def by_kind(docs, kind, component=None):
+    out = []
+    for doc in docs:
+        if doc["kind"] != kind:
+            continue
+        labels = doc["metadata"].get("labels", {})
+        if component and labels.get("app.kubernetes.io/component") != component:
+            continue
+        out.append(doc)
+    return out
+
+
+def container(deployment, name):
+    for c in deployment["spec"]["template"]["spec"]["containers"]:
+        if c["name"] == name:
+            return c
+    raise AssertionError(f"no container {name!r}")
+
+
+def env_map(container_spec):
+    out = {}
+    for env in container_spec.get("env", []):
+        if "value" in env:
+            out[env["name"]] = env["value"]
+    return out
+
+
+def vllm_args(docs):
+    dep = by_kind(docs, "Deployment", component="vllm")[0]
+    return container(dep, "vllm")["args"][0]
+
+
+def extract_kv_transfer(args_text: str) -> dict:
+    match = re.search(r"--kv-transfer-config '([^']+)'", args_text)
+    assert match, "no --kv-transfer-config in vllm args"
+    return json.loads(match.group(1))
+
+
+def extract_kv_events(args_text: str) -> dict:
+    match = re.search(r'--kv-events-config "((?:[^"\\]|\\.)+)"', args_text)
+    assert match, "no --kv-events-config in vllm args"
+    return json.loads(match.group(1).replace('\\"', '"'))
+
+
+def flag_value(args_text: str, flag: str) -> str:
+    match = re.search(rf"{flag}\s+(\S+)", args_text)
+    assert match, f"no {flag} in vllm args"
+    return match.group(1).rstrip("\\").strip()
+
+
+class TestDefaultRender:
+    def test_all_documents_parse_with_kind_and_name(self):
+        docs = render()
+        assert len(docs) >= 7
+        for doc in docs:
+            assert doc["kind"]
+            assert doc["metadata"]["name"]
+
+    def test_expected_components_present(self):
+        docs = render()
+        kinds = {(d["kind"], d["metadata"]["name"]) for d in docs}
+        names = {name for _, name in kinds}
+        assert any("vllm" in n for n in names)
+        assert any("indexer" in n for n in names)
+        assert ("PersistentVolumeClaim", "kvtpu-shared-kv") in kinds
+        # Discovery mode needs the pod list/watch grant.
+        assert any(k == "Role" for k, _ in kinds)
+
+    def test_tpu_nodepool_no_gpu(self):
+        docs = render()
+        dep = by_kind(docs, "Deployment", component="vllm")[0]
+        pod = dep["spec"]["template"]["spec"]
+        assert (
+            pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+            == "tpu-v5-lite-podslice"
+        )
+        resources = container(dep, "vllm")["resources"]
+        assert resources["requests"]["google.com/tpu"] == "4"
+        assert resources["limits"]["google.com/tpu"] == "4"
+        rendered = yaml.safe_dump(dep)
+        assert "nvidia.com/gpu" not in rendered
+
+
+class TestCrossInvariants:
+    """A mismatch in any of these silently zeroes the cache-hit rate."""
+
+    def test_hash_seed_agrees(self):
+        docs = render()
+        vllm_env = env_map(
+            container(by_kind(docs, "Deployment", component="vllm")[0], "vllm")
+        )
+        idx_env = env_map(
+            container(
+                by_kind(docs, "Deployment", component="indexer")[0], "indexer"
+            )
+        )
+        assert vllm_env["PYTHONHASHSEED"] == idx_env["PYTHONHASHSEED"]
+
+    def test_block_size_agrees(self):
+        docs = render()
+        args = vllm_args(docs)
+        idx_env = env_map(
+            container(
+                by_kind(docs, "Deployment", component="indexer")[0], "indexer"
+            )
+        )
+        assert flag_value(args, "--block-size") == idx_env["BLOCK_SIZE"]
+
+    def test_offload_block_size_multiple_of_device(self):
+        docs = render()
+        args = vllm_args(docs)
+        transfer = extract_kv_transfer(args)
+        extra = transfer["kv_connector_extra_config"]
+        device_bs = int(flag_value(args, "--block-size"))
+        assert extra["block_size"] % device_bs == 0
+        assert extra["spec_name"] == "TPUSharedStorageOffloadingSpec"
+        assert (
+            extra["spec_module_path"]
+            == "llm_d_kv_cache_manager_tpu.offload.vllm_spec"
+        )
+
+    def test_engine_hash_algo_is_cbor_interop(self):
+        # sha256_cbor engine hashes are absorbed by the indexer's
+        # engineKey->requestKey dual-key mapping; the flag must be set
+        # whenever events are on (reference deployment.yaml:85).
+        args = vllm_args(render())
+        assert "--prefix-caching-hash-algo sha256_cbor" in args
+
+    def test_zmq_port_and_topic_agree(self):
+        docs = render()
+        args = vllm_args(docs)
+        events = extract_kv_events(args)
+        idx_env = env_map(
+            container(
+                by_kind(docs, "Deployment", component="indexer")[0], "indexer"
+            )
+        )
+        assert events["enable_kv_cache_events"] is True
+        assert events["publisher"] == "zmq"
+        # Discovery mode: pod binds locally; indexer dials POD_SOCKET_PORT.
+        port = int(events["endpoint"].rsplit(":", 1)[1])
+        assert port == int(idx_env["POD_SOCKET_PORT"])
+        assert events["topic"].startswith(idx_env["ZMQ_TOPIC"])
+
+    def test_discovery_label_matches_selector(self):
+        docs = render()
+        vllm_labels = by_kind(docs, "Deployment", component="vllm")[0][
+            "spec"
+        ]["template"]["metadata"]["labels"]
+        idx_env = env_map(
+            container(
+                by_kind(docs, "Deployment", component="indexer")[0], "indexer"
+            )
+        )
+        key, _, value = idx_env["POD_LABEL_SELECTOR"].partition("=")
+        assert vllm_labels.get(key) == value
+
+    def test_shared_storage_path_is_mounted(self):
+        docs = render()
+        dep = by_kind(docs, "Deployment", component="vllm")[0]
+        args = vllm_args(docs)
+        extra = extract_kv_transfer(args)["kv_connector_extra_config"]
+        mounts = {
+            m["name"]: m["mountPath"]
+            for m in container(dep, "vllm")["volumeMounts"]
+        }
+        assert extra["shared_storage_path"].startswith(mounts["shared-kv"])
+        volumes = {
+            v["name"]: v for v in dep["spec"]["template"]["spec"]["volumes"]
+        }
+        claim = volumes["shared-kv"]["persistentVolumeClaim"]["claimName"]
+        pvc = by_kind(docs, "PersistentVolumeClaim")[0]
+        assert pvc["metadata"]["name"] == claim
+        assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+
+    def test_model_name_agrees(self):
+        docs = render()
+        args = vllm_args(docs)
+        served = args.split("vllm serve ", 1)[1].split()[0]
+        idx_env = env_map(
+            container(
+                by_kind(docs, "Deployment", component="indexer")[0], "indexer"
+            )
+        )
+        assert idx_env["MODEL_NAME"] == served
+
+    def test_tensor_parallel_within_pod_chips(self):
+        docs = render()
+        args = vllm_args(docs)
+        dep = by_kind(docs, "Deployment", component="vllm")[0]
+        chips = int(container(dep, "vllm")["resources"]["requests"][
+            "google.com/tpu"
+        ])
+        assert int(flag_value(args, "--tensor-parallel-size")) <= chips
+
+
+class TestVariants:
+    def test_central_socket_mode(self):
+        docs = render(**{"indexer.discovery": "false"})
+        assert not by_kind(docs, "Role")  # no RBAC needed
+        idx = by_kind(docs, "Deployment", component="indexer")[0]
+        idx_env = env_map(container(idx, "indexer"))
+        assert "POD_DISCOVERY" not in idx_env
+        assert idx_env["ZMQ_ENDPOINT"].startswith("tcp://*:")
+        bind_port = int(idx_env["ZMQ_ENDPOINT"].rsplit(":", 1)[1])
+        # vLLM connects OUT to the indexer service, same port.
+        events = extract_kv_events(vllm_args(docs))
+        assert "kv-cache-indexer" in events["endpoint"]
+        assert int(events["endpoint"].rsplit(":", 1)[1]) == bind_port
+        # The service must expose the ZMQ port in this topology.
+        svc = by_kind(docs, "Service", component="indexer")[0]
+        ports = {p["name"]: p["port"] for p in svc["spec"]["ports"]}
+        assert ports["zmq"] == bind_port
+
+    def test_valkey_mode_wires_index_backend(self):
+        docs = render(**{"valkey.enabled": "true"})
+        valkey_svc = by_kind(docs, "Service", component="valkey")[0]
+        idx_env = env_map(
+            container(
+                by_kind(docs, "Deployment", component="indexer")[0], "indexer"
+            )
+        )
+        backend = idx_env["INDEX_BACKEND"]
+        assert backend.startswith("valkey://")
+        assert valkey_svc["metadata"]["name"] in backend
+        port = valkey_svc["spec"]["ports"][0]["port"]
+        assert backend.endswith(f":{port}")
+
+    def test_valkey_disabled_omits_backend(self):
+        idx_env = env_map(
+            container(
+                by_kind(render(), "Deployment", component="indexer")[0],
+                "indexer",
+            )
+        )
+        assert "INDEX_BACKEND" not in idx_env
+
+    def test_seed_override_flows_everywhere(self):
+        docs = render(**{"hashSeed": '"7"'})
+        vllm_env = env_map(
+            container(by_kind(docs, "Deployment", component="vllm")[0], "vllm")
+        )
+        idx_env = env_map(
+            container(
+                by_kind(docs, "Deployment", component="indexer")[0], "indexer"
+            )
+        )
+        assert vllm_env["PYTHONHASHSEED"] == "7"
+        assert idx_env["PYTHONHASHSEED"] == "7"
+
+    def test_existing_claim_suppresses_pvc(self):
+        docs = render(**{"sharedStorage.existingClaim": "my-filestore"})
+        assert not by_kind(docs, "PersistentVolumeClaim")
+        dep = by_kind(docs, "Deployment", component="vllm")[0]
+        volumes = {
+            v["name"]: v for v in dep["spec"]["template"]["spec"]["volumes"]
+        }
+        claim = volumes["shared-kv"]["persistentVolumeClaim"]["claimName"]
+        assert claim == "my-filestore"
+
+    def test_secret_create_renders_secret(self):
+        docs = render(
+            **{"secret.create": "true", "secret.hfTokenValue": "hf_abc"}
+        )
+        secrets = by_kind(docs, "Secret")
+        assert len(secrets) == 1
+        assert secrets[0]["stringData"]["hf_token"] == "hf_abc"
+
+    def test_offload_disabled_drops_transfer_config(self):
+        args = vllm_args(render(**{"vllm.offload.enabled": "false"}))
+        assert "--kv-transfer-config" not in args
+        assert "--kv-events-config" in args  # events stay on
+
+    def test_offload_without_shared_storage_fails_render(self):
+        with pytest.raises(ValueError, match="sharedStorage.enabled"):
+            render(
+                **{
+                    "sharedStorage.enabled": "false",
+                    # offload stays on by default — that's the trap the
+                    # guard closes.
+                }
+            )
+
+    def test_multi_replica_indexer_without_valkey_fails_render(self):
+        with pytest.raises(ValueError, match="valkey.enabled"):
+            render(**{"indexer.replicaCount": "2"})
+
+    def test_multi_replica_indexer_with_valkey_renders(self):
+        docs = render(
+            **{"indexer.replicaCount": "2", "valkey.enabled": "true"}
+        )
+        idx = by_kind(docs, "Deployment", component="indexer")[0]
+        assert idx["spec"]["replicas"] == 2
+
+    def test_namespace_defaults_to_default_like_helm(self):
+        # Real helm sets .Release.Namespace to "default" without -n; the
+        # subset renderer must agree or `make chart` output diverges by
+        # which binary is installed.
+        docs = render()
+        assert {d["metadata"]["namespace"] for d in docs} == {"default"}
+
+    def test_shell_command_has_no_dangling_continuation(self):
+        for overrides in (
+            {},
+            {"vllm.offload.enabled": "false"},
+            {"indexer.discovery": "false"},
+            {"indexer.enabled": "false"},
+        ):
+            args = vllm_args(render(**overrides))
+            lines = [ln.strip() for ln in args.strip().split("\n")]
+            assert not lines[-1].endswith("\\"), overrides
+            for line in lines[:-1]:
+                assert line.endswith("\\"), (overrides, line)
