@@ -3,13 +3,13 @@
 Reproduces the reference's headline experiment shape (BASELINE.md §1-2:
 N pods, long shared prefix + short unique question, precise KV-aware
 routing vs baseline scheduling) as a single-host simulation in which the
-*prefill compute is real*: every request runs the flagship Llama model
-on the default JAX device (the TPU chip under the driver; CPU
-otherwise).
+*prefill compute is real*: every request of the two anchored headline
+runs executes the flagship Llama model on the default JAX device (the
+TPU chip under the driver; CPU otherwise).
 
 - 4 simulated pods, each with its own paged KV pool (models/
   kv_cache_pool.py geometry) and a vLLM-style local prefix cache.
-- Workload: 8 prefix groups x 4 requests, 8192-token shared prefix +
+- Workload: 8 prefix groups x 6 requests, 8192-token shared prefix +
   256-token unique suffix, shuffled arrival order (fixed seed).
 - Write path is the real one: each prefill publishes BlockStored
   batches through the msgpack codec + sharded event pool into the
@@ -17,18 +17,32 @@ otherwise).
 - Read path is the real one: the precise scheduler calls
   Indexer.get_pod_scores (tokenize -> chained block hashes -> index
   lookup -> tier-weighted longest-prefix score) and routes argmax.
-- Load model: open-loop Poisson arrivals at 70% of the fleet's
-  ideal-routing capacity, each pod a FIFO server on a virtual clock
-  (the reference's headline regime — QPS-loaded fleets where
-  misrouting queues prefills, BASELINE.md §1-2).  Service times are
-  the *real measured* on-device prefill times: a pod with the prefix
-  cached runs ``prefill_continue`` over the 256-token suffix only; a
-  miss runs ``prefill_paged`` over all 8448 tokens.
+- Load model: open-loop Poisson arrivals, each pod a FIFO server on a
+  virtual clock (the reference's headline regime — QPS-loaded fleets
+  where misrouting queues prefills, BASELINE.md §1-2).  Service times
+  are the *real measured* on-device prefill times: a pod with the
+  prefix cached runs ``prefill_continue`` over the 256-token suffix
+  only; a miss runs ``prefill_paged`` over all 8448 tokens.
 - TTFT per request = routing + queue wait + service.
 
-Metric: p50-TTFT speedup of precise routing over round-robin — the
-BASELINE.json north star (target >= 3x at >= 60% prefix-cache hit
-rate), so ``vs_baseline`` = speedup / 3.0.
+Three layers of output (one JSON line, reference benchmarking/73-
+capacity regime):
+
+1. **Headline** (real compute per request): p50-TTFT speedup of
+   precise routing over round-robin at 70% of ideal capacity — the
+   BASELINE.json north star (>= 3x at >= 60% hit rate), so
+   ``vs_baseline`` = speedup / 3.0.
+2. **Matrix** (detail.matrix): 5 strategies (precise / estimated /
+   load / random / round_robin, per the reference's strategy tables,
+   benchmarking/73-capacity/README.md:241-419) x a QPS ladder x >= 3
+   arrival seeds on the same virtual clock with the measured service
+   times; p50+p90 TTFT, mean queue depth, hit rate.  The precise
+   strategy runs the full real indexer read+write path per request.
+3. **Compute** (detail.mfu / detail.kernels): prefill tok/s and MFU of
+   the real on-device prefill, plus compiled-mode timings of the
+   Pallas kernels vs their XLA counterparts at serving shapes, with a
+   bench-time equality assert (the decode winner is routed into
+   models/llama.py via LlamaConfig.decode_attention).
 """
 
 from __future__ import annotations
@@ -123,22 +137,28 @@ def make_prompts(rng: random.Random) -> List[Tuple[int, str, List[int]]]:
 
 
 class SimPod:
-    """One simulated serving pod: paged pool + local prefix cache."""
+    """One simulated serving pod: paged pool + local prefix cache.
 
-    def __init__(self, name: str, params) -> None:
+    ``with_kv=False`` (matrix runs) keeps the block-allocator and
+    prefix-cache bookkeeping but skips the ~1.1 GB device pool — the
+    virtual-clock runs never touch the device."""
+
+    def __init__(self, name: str, params, with_kv: bool = True) -> None:
         self.name = name
         self.params = params
-        self.kv = jnp.zeros(
-            (
-                CFG.n_layers,
-                POOL_BLOCKS,
-                2,
-                CFG.block_size,
-                CFG.n_kv_heads,
-                CFG.head_dim,
-            ),
-            jnp.bfloat16,
-        )
+        self.kv = None
+        if with_kv:
+            self.kv = jnp.zeros(
+                (
+                    CFG.n_layers,
+                    POOL_BLOCKS,
+                    2,
+                    CFG.block_size,
+                    CFG.n_kv_heads,
+                    CFG.head_dim,
+                ),
+                jnp.bfloat16,
+            )
         self._next_block = 0
         # Engine-side prefix cache: chained block hash -> pool block id,
         # plus the reverse map so reuse evicts the old resident.

@@ -57,6 +57,11 @@ class LlamaConfig:
     # long-context prefill path).  Static shapes make this a trace-time
     # choice.
     flash_attention_min_len: int = 1024
+    # Decode attention over the paged pool: "auto" picks the Pallas
+    # kernel on TPU and the portable XLA gather elsewhere; "pallas" /
+    # "gather" force one path (bench.py measures both on the real chip
+    # and this is the knob to act on the result).
+    decode_attention: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -366,9 +371,18 @@ def decode_step(
             kv_new.astype(kv_layer.dtype)
         )
         # On TPU the Pallas kernel streams only the table's blocks
-        # HBM->VMEM (~2.5x the XLA gather path, which materializes the
-        # whole context); elsewhere keep the portable gather.
-        if jax.default_backend() == "tpu":
+        # HBM->VMEM (vs the XLA gather path, which materializes the
+        # whole context); elsewhere the portable gather.  bench.py times
+        # both compiled on the real chip (detail.kernels) —
+        # cfg.decode_attention overrides if the measurement disagrees.
+        use_pallas = (
+            cfg.decode_attention == "pallas"
+            or (
+                cfg.decode_attention == "auto"
+                and jax.default_backend() == "tpu"
+            )
+        )
+        if use_pallas:
             attn = paged_decode_attention_pallas(
                 q[:, 0], kv_layer, block_table, context_len
             )
