@@ -18,6 +18,16 @@ Digest semantics (reference pool.go:233-334):
   (pool.go:328-329) — engines emit granular removals too.
 
 Poison pills (undecodable payloads) are dropped, never retried.
+
+Each shard queue is *bounded* (``PoolConfig.max_queue_depth``, matching the
+reference's bounded per-shard workqueues, pool.go:134-173).  When a shard
+fills — an event storm, or a stuck index backend wedging one worker — the
+pool drops the *oldest* queued message from that shard to admit the new
+one, and counts it in ``kvtpu_kvevents_dropped_total{reason="queue_full"}``.
+Drop-oldest is the right policy for an ephemeral index: the newest events
+describe the pod's current cache contents; stale ones were about to be
+superseded anyway, and per-pod relative ordering of the surviving messages
+is preserved.
 """
 
 from __future__ import annotations
@@ -41,6 +51,7 @@ from llm_d_kv_cache_manager_tpu.kvevents.events import (
     decode_event,
     decode_event_batch,
 )
+from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
 
 logger = get_logger("kvevents.pool")
@@ -75,6 +86,9 @@ class Message:
 class PoolConfig:
     concurrency: int = 4
     default_device_tier: str = DEFAULT_EVENT_SOURCE_DEVICE_TIER
+    # Per-shard queue bound.  At the default, 4 shards hold up to 16k
+    # in-flight messages (~tens of MB of msgpack) before load-shedding.
+    max_queue_depth: int = 4096
 
 
 class Pool:
@@ -91,8 +105,11 @@ class Pool:
             raise ValueError("pool concurrency must be positive")
         self._index = index
         self._token_processor = token_processor
+        if self.config.max_queue_depth <= 0:
+            raise ValueError("pool max_queue_depth must be positive")
         self._queues: List["queue.Queue[Optional[Message]]"] = [
-            queue.Queue() for _ in range(self.config.concurrency)
+            queue.Queue(maxsize=self.config.max_queue_depth)
+            for _ in range(self.config.concurrency)
         ]
         self._threads: List[threading.Thread] = []
         self._started = False
@@ -118,7 +135,7 @@ class Pool:
             if not self._started:
                 return
             for q in self._queues:
-                q.put(None)
+                self._put_sentinel(q)
             for thread in self._threads:
                 thread.join(timeout=10)
             self._threads.clear()
@@ -131,7 +148,53 @@ class Pool:
 
     def add_task(self, message: Message) -> None:
         shard = fnv1a_32(message.pod_identifier.encode()) % len(self._queues)
-        self._queues[shard].put(message)
+        q = self._queues[shard]
+        while True:
+            try:
+                q.put_nowait(message)
+                return
+            except queue.Full:
+                pass
+            # Shed the oldest queued message from this shard to admit the
+            # new one (see module docstring for why drop-oldest).
+            try:
+                dropped = q.get_nowait()
+            except queue.Empty:
+                continue  # a worker drained it between put and get; retry
+            q.task_done()
+            if dropped is None:
+                # Raced with shutdown: the popped item was the stop
+                # sentinel.  Drop the NEW message instead and restore the
+                # sentinel so the worker still exits.
+                try:
+                    q.put_nowait(None)
+                except queue.Full:
+                    pass  # thread join below has a timeout; never block
+                METRICS.kvevents_dropped.labels(reason="shutdown").inc()
+                return
+            METRICS.kvevents_dropped.labels(reason="queue_full").inc()
+            logger.debug(
+                "event shard %d full (depth %d); dropped oldest message "
+                "from pod %s",
+                shard,
+                self.config.max_queue_depth,
+                dropped.pod_identifier,
+            )
+
+    @staticmethod
+    def _put_sentinel(q: "queue.Queue[Optional[Message]]") -> None:
+        """Enqueue the stop sentinel, shedding old messages if full."""
+        while True:
+            try:
+                q.put_nowait(None)
+                return
+            except queue.Full:
+                try:
+                    q.get_nowait()
+                    q.task_done()
+                    METRICS.kvevents_dropped.labels(reason="shutdown").inc()
+                except queue.Empty:
+                    pass
 
     def _worker(self, worker_index: int) -> None:
         q = self._queues[worker_index]

@@ -75,6 +75,12 @@ class KVCacheMetrics:
             ("tokenizer",),
             registry=self.registry,
         )
+        self.kvevents_dropped = Counter(
+            f"{_NAMESPACE}_kvevents_dropped_total",
+            "KV-event messages dropped by the ingestion pool by reason.",
+            ("reason",),
+            registry=self.registry,
+        )
         self.offload_bytes = Counter(
             f"{_NAMESPACE}_offload_bytes_total",
             "Bytes moved by the offload engine by direction.",

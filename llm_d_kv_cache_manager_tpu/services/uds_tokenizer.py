@@ -16,6 +16,8 @@ Message caps mirror the reference client's 100 MB limits
 from __future__ import annotations
 
 import os
+import re
+import shutil
 import threading
 from concurrent import futures
 from typing import Dict, Optional
@@ -37,14 +39,171 @@ logger = get_logger("services.uds_tokenizer")
 
 MAX_MESSAGE_BYTES = 100 * 1024 * 1024
 
+_HUB_SEGMENT = re.compile(r"[A-Za-z0-9_.\-]+")
+
+# Download hygiene (reference: tokenizer_service/tokenizer.py:150-178):
+# a tokenizer sidecar must never pull model weights — snapshot downloads
+# are restricted to tokenizer-related files.  `tokenizer.model` is added
+# beyond the reference's list so sentencepiece-only models work too.
+TOKENIZER_FILE_PATTERNS = [
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+    "vocab.json",
+    "merges.txt",
+    "config.json",
+    "generation_config.json",
+    "tokenizer.model",
+]
+
+# A usable cached download: config plus either a fast-tokenizer json or
+# a sentencepiece model (reference tokenizer.py:84-88 requires
+# tokenizer.json only, which would re-download sentencepiece-only
+# models forever).
+REQUIRED_CACHED_FILE = "config.json"
+ANY_OF_CACHED_FILES = ("tokenizer.json", "tokenizer.model")
+
+
+def _is_cached(local_path: str) -> bool:
+    return os.path.exists(
+        os.path.join(local_path, REQUIRED_CACHED_FILE)
+    ) and any(
+        os.path.exists(os.path.join(local_path, f))
+        for f in ANY_OF_CACHED_FILES
+    )
+
+
+def is_remote_model(model_identifier: str) -> bool:
+    """Remote hub name (``org/model``) vs local filesystem path
+    (reference tokenizer.py:196-214)."""
+    if os.path.isabs(model_identifier):
+        return False
+    if model_identifier.startswith(("./", "../")):
+        return False
+    if os.path.exists(model_identifier):
+        return False
+    return True
+
+
+def _validate_hub_id(model_identifier: str) -> None:
+    """Hub ids name the cache subdirectory; refuse anything that could
+    traverse out of it (a UDS client controls this string)."""
+    parts = model_identifier.split("/")
+    if len(parts) > 2 or not all(
+        part and part.strip(".") and _HUB_SEGMENT.fullmatch(part)
+        for part in parts
+    ):
+        raise ValueError(
+            f"invalid hub model identifier {model_identifier!r}"
+        )
+
+
+def _default_cache_dir() -> str:
+    return os.environ.get(
+        "TOKENIZER_CACHE_DIR",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "kvtpu", "tokenizers"
+        ),
+    )
+
+
+def fetch_tokenizer_files(
+    model_identifier: str, cache_dir: Optional[str] = None
+) -> str:
+    """Materialize ONLY tokenizer-related files locally; return the path.
+
+    Resolution order (reference tokenizer.py:60-104):
+
+    1. Local path: returned as-is, nothing downloaded.
+    2. Sidecar cache hit (config + tokenizer.json/.model present): reused.
+    3. Snapshot download restricted to ``TOKENIZER_FILE_PATTERNS`` from
+       ModelScope when ``USE_MODELSCOPE=true``, else Hugging Face.
+
+    Downloads land in a temp directory and are renamed into place only
+    when complete, so a half-written download (network blip mid-snapshot)
+    can never masquerade as a cache hit on the next call.
+    """
+    if not is_remote_model(model_identifier):
+        return model_identifier
+    _validate_hub_id(model_identifier)
+
+    local_path = os.path.join(
+        cache_dir or _default_cache_dir(), *model_identifier.split("/")
+    )
+    if _is_cached(local_path):
+        logger.info("using cached tokenizer files at %s", local_path)
+        return local_path
+
+    use_modelscope = (
+        os.environ.get("USE_MODELSCOPE", "false").lower() == "true"
+    )
+    if use_modelscope:
+        from modelscope import snapshot_download
+    else:
+        from huggingface_hub import snapshot_download
+    tmp_path = f"{local_path}.tmp-{os.getpid()}"
+    os.makedirs(tmp_path, exist_ok=True)
+    try:
+        snapshot_download(
+            model_identifier,
+            local_dir=tmp_path,
+            allow_patterns=TOKENIZER_FILE_PATTERNS,
+        )
+    except Exception:
+        shutil.rmtree(tmp_path, ignore_errors=True)
+        logger.exception(
+            "tokenizer-file download failed for %s (%s)",
+            model_identifier,
+            "modelscope" if use_modelscope else "huggingface",
+        )
+        raise
+    if os.path.isdir(local_path):  # lost a concurrent-download race
+        shutil.rmtree(tmp_path, ignore_errors=True)
+    else:
+        os.makedirs(os.path.dirname(local_path), exist_ok=True)
+        os.replace(tmp_path, local_path)
+    logger.info(
+        "downloaded tokenizer files for %s to %s",
+        model_identifier,
+        local_path,
+    )
+    return local_path
+
+
+def load_sidecar_tokenizer(model_identifier: str):
+    """Tokenizer-files-only load for the sidecar.
+
+    Cache-first like ``load_auto_tokenizer``: the standard HF cache is
+    tried before any network touch (zero-egress pods with warm caches
+    must keep working), then the tokenizer-files-only download, then the
+    full ``AutoTokenizer`` path as a last resort.
+    """
+    from transformers import AutoTokenizer
+
+    if is_remote_model(model_identifier):
+        try:
+            return AutoTokenizer.from_pretrained(
+                model_identifier, use_fast=True, local_files_only=True
+            )
+        except Exception:
+            pass  # not in the global HF cache; try the sidecar path
+    try:
+        path = fetch_tokenizer_files(model_identifier)
+    except ImportError:  # no hub client available
+        return load_auto_tokenizer(model_identifier)
+    if path == model_identifier:
+        return load_auto_tokenizer(model_identifier)
+    return AutoTokenizer.from_pretrained(path, use_fast=True)
+
 
 class TokenizerRegistry:
     """Thread-safe per-model tokenizer cache (reference:
     tokenizer_service/tokenizer.py:104-140)."""
 
-    def __init__(self) -> None:
+    def __init__(self, loader=load_sidecar_tokenizer) -> None:
         self._tokenizers: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self._loader = loader
 
     def register(self, model_name: str, tokenizer) -> None:
         """Inject a pre-built tokenizer (tests, local models)."""
@@ -55,7 +214,7 @@ class TokenizerRegistry:
         with self._lock:
             tokenizer = self._tokenizers.get(model_name)
         if tokenizer is None:
-            tokenizer = load_auto_tokenizer(model_name)
+            tokenizer = self._loader(model_name)
             with self._lock:
                 self._tokenizers[model_name] = tokenizer
         return tokenizer

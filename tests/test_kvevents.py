@@ -289,3 +289,89 @@ class TestPoolDigest:
 def test_shard_selection_is_stable():
     assert fnv1a_32(b"pod-1") == fnv1a_32(b"pod-1")
     assert fnv1a_32(b"pod-1") != fnv1a_32(b"pod-2")
+
+
+class TestBoundedQueues:
+    """Flooding one shard must shed oldest messages, never grow unbounded
+    (reference shards over bounded workqueues, pool.go:134-173)."""
+
+    @staticmethod
+    def _dropped_total() -> float:
+        from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+        total = 0.0
+        for metric in METRICS.kvevents_dropped.collect():
+            for sample in metric.samples:
+                if sample.name.endswith("_total"):
+                    total += sample.value
+        return total
+
+    def _message(self, i: int) -> Message:
+        batch = EventBatch(
+            ts=float(i),
+            events=[
+                BlockStored(
+                    block_hashes=[i + 1],
+                    parent_block_hash=None,
+                    token_ids=[1, 2, 3, 4],
+                    block_size=4,
+                )
+            ],
+        )
+        return Message(
+            topic=f"kv@{POD}@{MODEL}",
+            payload=batch.encode(),
+            pod_identifier=POD,  # one pod => one shard
+            model_name=MODEL,
+        )
+
+    def test_flood_is_bounded_and_counted(self):
+        depth = 8
+        index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        # NOT started: the single shard fills and must shed, not block.
+        pool = Pool(
+            index, db, PoolConfig(concurrency=1, max_queue_depth=depth)
+        )
+        before = self._dropped_total()
+        flood = 3 * depth
+        for i in range(flood):
+            pool.add_task(self._message(i))
+        assert pool._queues[0].qsize() == depth
+        assert self._dropped_total() - before == flood - depth
+        # The survivors are the NEWEST messages, still in order.
+        queued = list(pool._queues[0].queue)
+        timestamps = [decode_event_batch(m.payload).ts for m in queued]
+        assert timestamps == [float(i) for i in range(flood - depth, flood)]
+        # Draining after start processes exactly the survivors.
+        pool.start()
+        pool.drain()
+        assert index.get_request_key(flood)  # newest survived
+        with pytest.raises(KeyError):
+            index.get_request_key(1)  # oldest was shed
+        pool.shutdown()
+
+    def test_shutdown_with_full_queue_does_not_block(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = Pool(index, db, PoolConfig(concurrency=1, max_queue_depth=2))
+        pool.start()
+        pool.drain()
+        # Wedge by never starting a second pool; fill its queue, then
+        # shutdown must still complete promptly.
+        wedged = Pool(
+            index, db, PoolConfig(concurrency=1, max_queue_depth=2)
+        )
+        for i in range(4):
+            wedged.add_task(self._message(i))
+        wedged._started = True  # simulate started-but-stuck workers
+        wedged._threads = []
+        wedged.shutdown()  # must not deadlock inserting the sentinel
+        assert wedged._queues[0].queue[-1] is None
+        pool.shutdown()
+
+    def test_invalid_depth_rejected(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=10))
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        with pytest.raises(ValueError):
+            Pool(index, db, PoolConfig(concurrency=1, max_queue_depth=0))

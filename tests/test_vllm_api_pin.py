@@ -1,0 +1,166 @@
+"""Pin the vLLM v1 kv_offload API surface the adapter implements.
+
+Round-2 flagged that ``offload/vllm_spec.py`` had never met real vLLM:
+its tests exercise duck-typed stand-ins, so silent drift between our
+adapter and the real ``vllm.v1.kv_offload`` ABCs would pass every test
+and fail only inside a serving pod.  This module closes that hole from
+both ends:
+
+* ``PINNED_API`` records the abstract surface as used by the reference
+  adapter (kv_connectors/llmd_fs_backend/llmd_fs_backend/{spec,manager,
+  worker}.py — the same vLLM contract we target).
+* The adapter classes are checked against the pin ALWAYS (no vllm
+  needed): every pinned method must exist with the pinned positional
+  parameters.
+* When real vllm IS importable (inside a serving image's CI), the pin is
+  checked against the live ABCs, so an upstream signature change fails
+  here first with a message naming the drift.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from llm_d_kv_cache_manager_tpu.offload import vllm_spec
+
+# class name in vllm.v1.kv_offload -> {method: positional params}.
+PINNED_API = {
+    "OffloadingManager": {
+        "lookup": ["self", "block_hashes"],
+        "prepare_load": ["self", "block_hashes"],
+        "touch": ["self", "block_hashes"],
+        "complete_load": ["self", "block_hashes"],
+        "prepare_store": ["self", "block_hashes"],
+        "complete_store": ["self", "block_hashes", "success"],
+    },
+    "OffloadingSpec": {
+        "__init__": ["self", "vllm_config", "kv_cache_config"],
+        "get_manager": ["self"],
+        "get_handlers": ["self", "kv_caches", "attn_backends"],
+    },
+    "OffloadingHandler": {
+        "transfer_async": ["self", "job_id", "spec"],
+        "get_finished": ["self"],
+    },
+}
+
+# Fields PrepareStoreOutput must accept (reference manager.py:92-97).
+PINNED_PREPARE_STORE_FIELDS = [
+    "block_hashes_to_store",
+    "store_spec",
+    "block_hashes_evicted",
+]
+
+ADAPTERS = {
+    "OffloadingManager": vllm_spec.TPUSharedStorageOffloadingManager,
+    "OffloadingSpec": vllm_spec.TPUSharedStorageOffloadingSpec,
+    "OffloadingHandler": vllm_spec.TPUToStorageHandler,
+}
+
+
+def _positional_params(func) -> list:
+    sig = inspect.signature(func)
+    return [
+        name
+        for name, p in sig.parameters.items()
+        if p.kind
+        in (
+            inspect.Parameter.POSITIONAL_ONLY,
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        )
+    ]
+
+
+class TestAdapterMatchesPin:
+    """Our classes implement every pinned method, pinned-compatibly."""
+
+    def test_manager_methods(self):
+        cls = ADAPTERS["OffloadingManager"]
+        for method, params in PINNED_API["OffloadingManager"].items():
+            fn = getattr(cls, method, None)
+            assert fn is not None, f"manager adapter missing {method}"
+            assert _positional_params(fn)[: len(params)] == params, (
+                f"manager.{method} signature drifted from the vLLM pin"
+            )
+
+    def test_handler_methods(self):
+        for cls in (
+            vllm_spec.TPUToStorageHandler,
+            vllm_spec.StorageToTPUHandler,
+        ):
+            for method, params in PINNED_API["OffloadingHandler"].items():
+                fn = getattr(cls, method, None)
+                assert fn is not None, f"{cls.__name__} missing {method}"
+                assert _positional_params(fn)[: len(params)] == params
+
+    def test_spec_methods(self):
+        cls = ADAPTERS["OffloadingSpec"]
+        for method, params in PINNED_API["OffloadingSpec"].items():
+            fn = getattr(cls, method, None)
+            assert fn is not None, f"spec adapter missing {method}"
+            assert _positional_params(fn)[: len(params)] == params
+
+    def test_prepare_store_output_fields(self):
+        out = vllm_spec.TPUSharedStorageOffloadingManager.prepare_store(
+            # unbound call with a stub self: prepare_store touches no state
+            object.__new__(vllm_spec.TPUSharedStorageOffloadingManager),
+            [1, 2, 3],
+        )
+        for field in PINNED_PREPARE_STORE_FIELDS:
+            assert hasattr(out, field), f"PrepareStoreOutput lacks {field}"
+        assert out.block_hashes_to_store == [1, 2, 3]
+        assert out.block_hashes_evicted == []
+
+    def test_mediums(self):
+        assert vllm_spec.GPULoadStoreSpec.medium() == "GPU"
+        assert (
+            vllm_spec.TPUSharedStorageLoadStoreSpec.medium()
+            == "SHARED_STORAGE"
+        )
+
+
+class TestPinMatchesRealVllm:
+    """With real vllm installed, the pin must match the live ABCs."""
+
+    def test_live_abstract_surface(self):
+        import pytest
+
+        vllm_abstract = pytest.importorskip("vllm.v1.kv_offload.abstract")
+        from vllm.v1.kv_offload.spec import OffloadingSpec
+        from vllm.v1.kv_offload.worker.worker import OffloadingHandler
+
+        live = {
+            "OffloadingManager": vllm_abstract.OffloadingManager,
+            "OffloadingSpec": OffloadingSpec,
+            "OffloadingHandler": OffloadingHandler,
+        }
+        for cls_name, methods in PINNED_API.items():
+            cls = live[cls_name]
+            for method, params in methods.items():
+                fn = getattr(cls, method, None)
+                assert fn is not None, (
+                    f"vllm {cls_name} no longer has {method}; update "
+                    "the adapter AND this pin"
+                )
+                live_params = _positional_params(fn)
+                assert live_params[: len(params)] == params, (
+                    f"vllm {cls_name}.{method} drifted: now {live_params}"
+                )
+            # New abstract requirements we don't pin => adapter breaks.
+            abstract = set(getattr(cls, "__abstractmethods__", ()))
+            unknown = abstract - set(methods)
+            assert not unknown, (
+                f"vllm {cls_name} grew abstract methods {sorted(unknown)} "
+                "the adapter does not implement"
+            )
+
+    def test_adapter_is_real_subclass(self):
+        import pytest
+
+        pytest.importorskip("vllm.v1.kv_offload.abstract")
+        assert vllm_spec.HAVE_VLLM
+        from vllm.v1.kv_offload.abstract import OffloadingManager
+
+        assert issubclass(
+            vllm_spec.TPUSharedStorageOffloadingManager, OffloadingManager
+        )
