@@ -48,6 +48,7 @@ capacity regime):
 from __future__ import annotations
 
 import json
+import os
 import random
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -97,6 +98,30 @@ CFG = llama.LlamaConfig(
 POOL_BLOCKS = 1536  # per pod: holds 2 groups' working set (precise
 # routing assigns NUM_GROUPS/NUM_PODS = 2 groups per pod); reuse evicts
 
+# Matrix axes (reference benchmarking/73-capacity: strategy tables over
+# a QPS ladder).  Fractions are of the fleet's ideal-routing capacity.
+STRATEGIES = ("precise", "estimated", "load", "random", "round_robin")
+QPS_FRACTIONS = (0.5, 0.6, 0.7, 0.8, 0.9)
+ARRIVAL_SEEDS = (7, 11, 13)
+
+if os.environ.get("KVTPU_BENCH_TINY"):
+    # Smoke-run geometry (CI / CPU): same code paths, minutes -> seconds.
+    NUM_GROUPS, REQS_PER_GROUP = 4, 4
+    PREFIX_TOKENS, SUFFIX_TOKENS = 512, 64
+    TOTAL_TOKENS = PREFIX_TOKENS + SUFFIX_TOKENS
+    CFG = llama.LlamaConfig(
+        vocab_size=2048,
+        d_model=256,
+        n_layers=2,
+        n_heads=8,
+        n_kv_heads=4,
+        d_ff=704,
+        block_size=BLOCK_SIZE,
+        dtype="float32",
+    )
+    POOL_BLOCKS = 160
+    ARRIVAL_SEEDS = (7, 11)
+
 
 class WordTokenizer:
     """Deterministic whitespace tokenizer (ASCII words -> stable ids)."""
@@ -143,7 +168,7 @@ class SimPod:
     prefix-cache bookkeeping but skips the ~1.1 GB device pool — the
     virtual-clock runs never touch the device."""
 
-    def __init__(self, name: str, params, with_kv: bool = True) -> None:
+    def __init__(self, name: str, params=None, with_kv: bool = True) -> None:
         self.name = name
         self.params = params
         self.kv = None
@@ -244,6 +269,171 @@ def publish_events(
             model_name=MODEL_NAME,
         )
     )
+
+
+class EstimatedScorer:
+    """Scheduler-side prefix-affinity approximation (the reference's
+    "estimated" strategy, benchmarking/73-capacity/README.md:241-246):
+    scores pods by the scheduler's OWN routing history — no engine
+    events, so it is blind to evictions and to blocks cached by other
+    routes.  The gap between this and "precise" is the product's value
+    proposition."""
+
+    def __init__(self, capacity_per_pod: int = 200_000) -> None:
+        self.capacity = capacity_per_pod
+        self._assumed: Dict[str, Dict[int, None]] = {}
+
+    def pick(self, pod_names: Sequence[str], hashes: Sequence[int]):
+        """Pod with the longest assumed consecutive prefix, or None."""
+        best, best_len = None, 0
+        for name in pod_names:
+            assumed = self._assumed.get(name)
+            if not assumed:
+                continue
+            n = 0
+            for h in hashes:
+                if h not in assumed:
+                    break
+                n += 1
+            if n > best_len:
+                best, best_len = name, n
+        return best
+
+    def record(self, pod_name: str, hashes: Sequence[int]) -> None:
+        assumed = self._assumed.setdefault(pod_name, {})
+        for h in hashes:
+            assumed.pop(h, None)  # re-insert at LRU tail
+            assumed[h] = None
+        while len(assumed) > self.capacity:
+            assumed.pop(next(iter(assumed)))
+
+
+def run_fleet_virtual(
+    strategy: str,
+    requests,
+    hashes_list: Sequence[Sequence[int]],
+    arrivals: Sequence[float],
+    t_miss: float,
+    t_hit: float,
+    seed: int,
+) -> Tuple[List[float], float, float]:
+    """One matrix cell: the request stream under ``strategy`` on the
+    virtual clock, service times taken from the measured on-device
+    prefill costs.  Returns (TTFTs, hit rate, mean queue depth).
+
+    The "precise" strategy runs the REAL indexer read+write path per
+    request (tokenize -> chained hashes -> lookup -> score, plus the
+    event-pool write path); its routing time is measured wall clock and
+    charged to TTFT.  The other strategies route without the indexer:
+    "estimated" from scheduler-local affinity, "load" to the
+    least-backlogged pod, "random"/"round_robin" blind.
+    """
+    indexer = event_pool = None
+    estimated = None
+    rng = random.Random(31_000 + seed)
+    if strategy == "precise":
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=BLOCK_SIZE
+                ),
+                kvblock_index_config=IndexConfig(),
+            ),
+            tokenizer=WordTokenizer(),
+        )
+        indexer.run()
+        event_pool = Pool(
+            indexer.kv_block_index,
+            indexer.token_processor,
+            PoolConfig(concurrency=2),
+        )
+        event_pool.start()
+    elif strategy == "estimated":
+        estimated = EstimatedScorer()
+
+    pods = [SimPod(f"pod-{i}", with_kv=False) for i in range(NUM_PODS)]
+    pod_by_name = {p.name: p for p in pods}
+    n_prefix_blocks = PREFIX_TOKENS // BLOCK_SIZE
+
+    ttfts: List[float] = []
+    depths: List[int] = []
+    hits = 0
+    rr_next = 0
+    pod_free_at = {p.name: 0.0 for p in pods}
+    completions: Dict[str, List[float]] = {p.name: [] for p in pods}
+    try:
+        for ((group, text, tokens), hashes, arrival) in zip(
+            requests, hashes_list, arrivals
+        ):
+            routing_seconds = 0.0
+            if strategy == "precise":
+                t0 = time.perf_counter()
+                scores = indexer.get_pod_scores(
+                    text, MODEL_NAME, [p.name for p in pods]
+                )
+                routing_seconds = time.perf_counter() - t0
+                if scores and max(scores.values()) > 0:
+                    pod = pod_by_name[
+                        max(scores.items(), key=lambda kv: kv[1])[0]
+                    ]
+                else:
+                    pod = pods[rr_next % NUM_PODS]
+                    rr_next += 1
+            elif strategy == "estimated":
+                name = estimated.pick([p.name for p in pods], hashes)
+                if name is None:
+                    pod = pods[rr_next % NUM_PODS]
+                    rr_next += 1
+                else:
+                    pod = pod_by_name[name]
+            elif strategy == "load":
+                pod = min(pods, key=lambda p: (pod_free_at[p.name]))
+            elif strategy == "random":
+                pod = rng.choice(pods)
+            else:  # round_robin
+                pod = pods[rr_next % NUM_PODS]
+                rr_next += 1
+
+            cached_ids = pod.cached_prefix_blocks(hashes)
+            hit = len(cached_ids) >= n_prefix_blocks
+            if hit:
+                hits += 1
+                new_ids, evicted = pod.alloc(len(hashes) - n_prefix_blocks)
+                first_new = n_prefix_blocks
+                block_ids = cached_ids[:n_prefix_blocks] + new_ids
+            else:
+                new_ids, evicted = pod.alloc(len(hashes))
+                first_new = 0
+                block_ids = new_ids
+            service_seconds = t_hit if hit else t_miss
+
+            depths.append(
+                sum(1 for c in completions[pod.name] if c > arrival)
+            )
+            queue_start = max(arrival, pod_free_at[pod.name])
+            done = queue_start + service_seconds
+            pod_free_at[pod.name] = done
+            completions[pod.name].append(done)
+            ttfts.append(
+                routing_seconds + (queue_start - arrival) + service_seconds
+            )
+
+            for h, bid in zip(hashes[first_new:], block_ids[first_new:]):
+                pod.cached[h] = bid
+                pod._block_owner[bid] = h
+            if strategy == "precise":
+                publish_events(
+                    event_pool, pod, tokens, hashes, first_new, evicted
+                )
+                event_pool.drain()
+            elif strategy == "estimated":
+                estimated.record(pod.name, hashes)
+    finally:
+        if event_pool is not None:
+            event_pool.shutdown()
+        if indexer is not None:
+            indexer.shutdown()
+    return ttfts, hits / len(requests), float(np.mean(depths))
 
 
 def measure_readback_rtt() -> float:
@@ -390,6 +580,269 @@ def run_fleet(
     return ttfts, hits / len(requests)
 
 
+# ---------------- compute layers (detail.mfu / detail.kernels) ----------
+
+TIMING_CHAIN_STEPS = 24
+
+
+def time_chained(op, operand, readback_rtt: float = 0.0,
+                 steps: int = TIMING_CHAIN_STEPS) -> float:
+    """Compiled per-call latency through the remote-device tunnel.
+
+    ``block_until_ready`` is a no-op through the tunnel, so single-shot
+    timings are ~all RPC noise.  Instead: chain ``steps`` data-dependent
+    calls inside ONE jitted scan (the 1e-30-scaled feedback keeps the
+    value numerically unchanged while defeating constant folding), read
+    back once, subtract the measured readback floor, divide.
+    """
+    def chain(x):
+        def body(xc, _):
+            out = op(xc)
+            return xc + (1e-30 * out).astype(xc.dtype), None
+        xf, _ = jax.lax.scan(body, x, None, length=steps)
+        return xf
+
+    chained = jax.jit(chain)
+    float(jnp.sum(chained(operand)))  # compile + warm
+    best = float("inf")
+    for _ in range(3):  # min-of-3 bounds the RTT jitter contribution
+        t0 = time.perf_counter()
+        float(jnp.sum(chained(operand)))
+        best = min(best, time.perf_counter() - t0)
+    return max(best - readback_rtt, 1e-6) / steps
+
+
+def max_rel_err(a, b) -> float:
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    return float(np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-6))
+
+
+def bench_kernels(readback_rtt: float) -> dict:
+    """detail.kernels: Pallas vs XLA compiled at serving shapes.
+
+    Equality is asserted at bench time (a wrong-but-fast kernel must
+    fail the bench, not win it); the decode winner is routed into the
+    headline runs via ``LlamaConfig.decode_attention``.
+    """
+    if jax.default_backend() != "tpu":
+        return {"skipped": f"backend={jax.default_backend()}"}
+    from llm_d_kv_cache_manager_tpu.ops import flash_pallas
+    from llm_d_kv_cache_manager_tpu.ops.flash_attention import (
+        flash_gqa_attention,
+    )
+    from llm_d_kv_cache_manager_tpu.ops.paged_attention import (
+        paged_attention,
+    )
+    from llm_d_kv_cache_manager_tpu.ops.paged_decode_pallas import (
+        paged_decode_attention_pallas,
+    )
+
+    H, Hkv, Dh = CFG.n_heads, CFG.n_kv_heads, CFG.head_dim
+    B = 4  # concurrent decode batch at the fleet's serving shape
+    nblocks = TOTAL_TOKENS // BLOCK_SIZE
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    kv_layer = jax.random.normal(
+        k1, (POOL_BLOCKS, 2, BLOCK_SIZE, Hkv, Dh), jnp.bfloat16
+    )
+    q = jax.random.normal(k2, (B, H, Dh), jnp.bfloat16)
+    table = jnp.asarray(
+        np.stack(
+            [
+                np.random.RandomState(7 + i).permutation(POOL_BLOCKS)[
+                    :nblocks
+                ]
+                for i in range(B)
+            ]
+        ),
+        jnp.int32,
+    )
+    ctx = jnp.full((B,), TOTAL_TOKENS, jnp.int32)
+
+    decode_err = max_rel_err(
+        paged_decode_attention_pallas(q, kv_layer, table, ctx),
+        paged_attention(q, kv_layer, table, ctx),
+    )
+    assert decode_err < 0.05, (
+        f"paged-decode Pallas/XLA diverge: max rel err {decode_err:.4f}"
+    )
+    # Decode is sub-ms per call: long chains lift the measurement well
+    # above the tunnel's RTT jitter.
+    t_decode_pallas = time_chained(
+        lambda qq: paged_decode_attention_pallas(qq, kv_layer, table, ctx),
+        q,
+        readback_rtt,
+        steps=96,
+    )
+    t_decode_xla = time_chained(
+        lambda qq: paged_attention(qq, kv_layer, table, ctx),
+        q,
+        readback_rtt,
+        steps=96,
+    )
+    # "gather" is LlamaConfig.decode_attention's name for the XLA path.
+    decode_winner = (
+        "pallas" if t_decode_pallas <= t_decode_xla else "gather"
+    )
+
+    Tq = PREFIX_TOKENS  # the 8k shared-prefix prefill shape
+    qp = jax.random.normal(k3, (1, Tq, H, Dh), jnp.bfloat16)
+    kp = jax.random.normal(k1, (1, Tq, Hkv, Dh), jnp.bfloat16)
+    vp = jax.random.normal(k2, (1, Tq, Hkv, Dh), jnp.bfloat16)
+    flash_err = max_rel_err(
+        flash_pallas.flash_gqa_attention_pallas(qp, kp, vp),
+        flash_gqa_attention(qp, kp, vp),
+    )
+    assert flash_err < 0.05, (
+        f"flash-prefill Pallas/XLA diverge: max rel err {flash_err:.4f}"
+    )
+    t_flash_pallas = time_chained(
+        lambda qq: flash_pallas.flash_gqa_attention_pallas(qq, kp, vp),
+        qp,
+        readback_rtt,
+    )
+    t_flash_xla = time_chained(
+        lambda qq: flash_gqa_attention(qq, kp, vp), qp, readback_rtt
+    )
+    return {
+        "paged_decode": {
+            "shape": f"B={B} ctx={TOTAL_TOKENS} blocks={nblocks}",
+            "pallas_us": round(t_decode_pallas * 1e6, 1),
+            "xla_gather_us": round(t_decode_xla * 1e6, 1),
+            "speedup_pallas": round(t_decode_xla / t_decode_pallas, 2),
+            "max_rel_err": round(decode_err, 5),
+            "winner": decode_winner,
+        },
+        "flash_prefill": {
+            "shape": f"B=1 T={Tq} H={H} D={Dh}",
+            "pallas_ms": round(t_flash_pallas * 1e3, 2),
+            "xla_scan_ms": round(t_flash_xla * 1e3, 2),
+            "speedup_pallas": round(t_flash_xla / t_flash_pallas, 2),
+            "max_rel_err": round(flash_err, 5),
+        },
+    }
+
+
+def model_prefill_flops(T: int) -> float:
+    """Matmul FLOPs of one dense prefill forward (causal-halved attn)."""
+    D, H, Hkv, Dh, F, L, V = (
+        CFG.d_model,
+        CFG.n_heads,
+        CFG.n_kv_heads,
+        CFG.head_dim,
+        CFG.d_ff,
+        CFG.n_layers,
+        CFG.vocab_size,
+    )
+    per_layer = (
+        2 * T * D * (H * Dh + 2 * Hkv * Dh)  # qkv projections
+        + 2 * T * T * H * Dh  # QK^T + AV, x2 flops, /2 causal
+        + 2 * T * H * Dh * D  # output projection
+        + 2 * T * 3 * D * F  # gate/up/down
+    )
+    return float(L * per_layer + 2 * T * D * V)  # + logits head
+
+
+# device_kind substrings -> peak dense bf16 TFLOP/s per chip (public
+# figures; v5p before v5 so the substring match is unambiguous).
+PEAK_BF16_TFLOPS = (
+    ("v6", 918.0),
+    ("trillium", 918.0),
+    ("v5p", 459.0),
+    ("v5", 197.0),  # v5e / v5 lite
+    ("v4", 275.0),
+)
+
+
+def bench_mfu(t_miss: float) -> dict:
+    """detail.mfu: measured full-prefill throughput vs chip peak."""
+    device = jax.devices()[0]
+    kind = device.device_kind.lower()
+    peak = next(
+        (tf for tag, tf in PEAK_BF16_TFLOPS if tag in kind), None
+    )
+    flops = model_prefill_flops(TOTAL_TOKENS)
+    achieved_tflops = flops / t_miss / 1e12
+    return {
+        "prefill_tokens": TOTAL_TOKENS,
+        "prefill_tok_s": round(TOTAL_TOKENS / t_miss, 1),
+        "model_flops_per_prefill": flops,
+        "achieved_tflops": round(achieved_tflops, 2),
+        "device_kind": device.device_kind,
+        "peak_bf16_tflops": peak,
+        "mfu": round(achieved_tflops / peak, 4) if peak else None,
+    }
+
+
+def warmup_indexes(requests) -> set:
+    """Each group's FIRST arrival: an unavoidable cold miss under ANY
+    scheduler (the reference's harness likewise excludes warmup)."""
+    seen: set = set()
+    warm: set = set()
+    for i, (group, _, _) in enumerate(requests):
+        if group not in seen:
+            seen.add(group)
+            warm.add(i)
+    return warm
+
+
+def poisson_arrivals(qps: float, n: int, seed: int) -> List[float]:
+    arrival_rng = random.Random(seed)
+    clock, out = 0.0, []
+    for _ in range(n):
+        clock += arrival_rng.expovariate(qps)
+        out.append(clock)
+    return out
+
+
+def run_matrix(
+    requests,
+    hashes_list,
+    t_miss: float,
+    t_hit: float,
+    ideal_service: float,
+    warmup: set,
+) -> List[dict]:
+    """detail.matrix: strategies x QPS ladder x arrival seeds on the
+    virtual clock.  Per-seed values are reported raw (no averaging away
+    the spread the r3 review called out)."""
+    cells: List[dict] = []
+    for frac in QPS_FRACTIONS:
+        qps = frac * NUM_PODS / ideal_service
+        for strategy in STRATEGIES:
+            p50s, p90s, depths, hit_rates = [], [], [], []
+            for seed in ARRIVAL_SEEDS:
+                arrivals = poisson_arrivals(qps, len(requests), seed)
+                ttfts, hit_rate, depth = run_fleet_virtual(
+                    strategy,
+                    requests,
+                    hashes_list,
+                    arrivals,
+                    t_miss,
+                    t_hit,
+                    seed,
+                )
+                steady = [
+                    t for i, t in enumerate(ttfts) if i not in warmup
+                ]
+                p50s.append(round(float(np.percentile(steady, 50)), 4))
+                p90s.append(round(float(np.percentile(steady, 90)), 4))
+                depths.append(round(depth, 2))
+                hit_rates.append(round(hit_rate, 3))
+            cells.append(
+                {
+                    "strategy": strategy,
+                    "qps_frac": frac,
+                    "qps": round(qps, 2),
+                    "p50_ttft_s": p50s,
+                    "p90_ttft_s": p90s,
+                    "mean_queue_depth": depths,
+                    "hit_rate": hit_rates,
+                }
+            )
+    return cells
+
+
 def main() -> None:
     rng = random.Random(0)
     requests = make_prompts(rng)
@@ -435,9 +888,16 @@ def main() -> None:
     t_miss = max(t_miss - readback_rtt, 1e-4)
     t_hit = max(t_hit - readback_rtt, 1e-4)
 
+    # detail.kernels: compiled Pallas-vs-XLA at serving shapes, and the
+    # decode winner routed into the headline via decode_attention.
+    kernels = bench_kernels(readback_rtt)
+    decode_winner = kernels.get("paged_decode", {}).get("winner")
+    if decode_winner:
+        CFG.decode_attention = decode_winner
+
     # Secondary metric: decode throughput over the warm pod's full
     # 8448-token context (the reference's output-tok/s axis; decode
-    # attention is the Pallas paged kernel on TPU).
+    # attention is whichever kernel detail.kernels just measured ahead).
     decode = jax.jit(
         lambda p, t, kv, bt, cl: llama.decode_step(p, t, kv, bt, cl, CFG),
         donate_argnums=(2,),
@@ -458,6 +918,9 @@ def main() -> None:
     decode_tok_s = decode_steps / decode_elapsed
     del warm, logits
 
+    # detail.mfu: full-prefill throughput vs chip peak.
+    mfu = bench_mfu(t_miss)
+
     # Arrival rate: 70% of the fleet's capacity under *ideal* routing
     # (first request per group misses, the rest hit).  A well-routed
     # fleet is comfortably stable there; a hit-blind scheduler's
@@ -469,56 +932,87 @@ def main() -> None:
         ideal_miss_fraction * t_miss + (1 - ideal_miss_fraction) * t_hit
     )
     qps = 0.7 * NUM_PODS / ideal_service
-    arrival_rng = random.Random(7)
-    arrivals: List[float] = []
-    clock = 0.0
-    for _ in requests:
-        clock += arrival_rng.expovariate(qps)
-        arrivals.append(clock)
+    warmup_idx = warmup_indexes(requests)
 
-    rr_ttfts, rr_hit = run_fleet(
-        "round_robin", requests, params, prefill_full, prefill_suffix,
-        arrivals, readback_rtt,
-    )
-    pr_ttfts, pr_hit = run_fleet(
-        "precise", requests, params, prefill_full, prefill_suffix,
-        arrivals, readback_rtt,
+    # Headline: REAL on-device compute per request, across arrival
+    # seeds — one Poisson draw has ~±10-20% noise (burned r2->r3), so
+    # the reported value is the median seed and the spread is explicit.
+    per_seed: List[dict] = []
+    for seed in ARRIVAL_SEEDS:
+        arrivals = poisson_arrivals(qps, len(requests), seed)
+        rr_ttfts, rr_hit = run_fleet(
+            "round_robin", requests, params, prefill_full,
+            prefill_suffix, arrivals, readback_rtt,
+        )
+        pr_ttfts, pr_hit = run_fleet(
+            "precise", requests, params, prefill_full, prefill_suffix,
+            arrivals, readback_rtt,
+        )
+        rr_steady = [
+            t for i, t in enumerate(rr_ttfts) if i not in warmup_idx
+        ]
+        pr_steady = [
+            t for i, t in enumerate(pr_ttfts) if i not in warmup_idx
+        ]
+        p50_rr = float(np.percentile(rr_steady, 50))
+        p50_pr = float(np.percentile(pr_steady, 50))
+        per_seed.append(
+            {
+                "seed": seed,
+                "speedup": round(p50_rr / p50_pr, 3) if p50_pr else 0.0,
+                "p50_ttft_precise_s": round(p50_pr, 5),
+                "p50_ttft_round_robin_s": round(p50_rr, 5),
+                "hit_rate_precise": round(pr_hit, 3),
+                "hit_rate_round_robin": round(rr_hit, 3),
+            }
+        )
+    by_speedup = sorted(per_seed, key=lambda s: s["speedup"])
+    # Lower-middle for even seed counts: a conservative headline, never
+    # the max masquerading as the median.
+    median = by_speedup[(len(by_speedup) - 1) // 2]
+    speedup = median["speedup"]
+
+    # detail.matrix: 5 strategies x QPS ladder x seeds, virtual clock.
+    hashes_list = [block_hash_chain(tokens) for _, _, tokens in requests]
+    matrix = run_matrix(
+        requests, hashes_list, t_miss, t_hit, ideal_service, warmup_idx
     )
 
-    # Each group's FIRST arrival is an unavoidable cold miss under ANY
-    # scheduler (the reference's harness likewise excludes its warmup
-    # stage); percentiles cover the steady-state samples.  Both
-    # schedulers share the arrival order, so the window is identical.
-    seen_groups: set = set()
-    warmup_idx = set()
-    for i, (group, _, _) in enumerate(requests):
-        if group not in seen_groups:
-            seen_groups.add(group)
-            warmup_idx.add(i)
-    rr_steady = [t for i, t in enumerate(rr_ttfts) if i not in warmup_idx]
-    pr_steady = [t for i, t in enumerate(pr_ttfts) if i not in warmup_idx]
-    p50_rr = float(np.percentile(rr_steady, 50))
-    p50_pr = float(np.percentile(pr_steady, 50))
-    speedup = p50_rr / p50_pr if p50_pr > 0 else 0.0
     print(
         json.dumps(
             {
                 "metric": "p50_ttft_speedup_precise_vs_round_robin",
-                "value": round(speedup, 3),
+                "value": speedup,
                 "unit": "x",
                 "vs_baseline": round(speedup / 3.0, 3),
                 "detail": {
-                    "p50_ttft_precise_s": round(p50_pr, 5),
-                    "p50_ttft_round_robin_s": round(p50_rr, 5),
-                    "prefix_cache_hit_rate_precise": round(pr_hit, 3),
-                    "prefix_cache_hit_rate_round_robin": round(rr_hit, 3),
+                    "p50_ttft_precise_s": median["p50_ttft_precise_s"],
+                    "p50_ttft_round_robin_s": median[
+                        "p50_ttft_round_robin_s"
+                    ],
+                    "prefix_cache_hit_rate_precise": median[
+                        "hit_rate_precise"
+                    ],
+                    "prefix_cache_hit_rate_round_robin": median[
+                        "hit_rate_round_robin"
+                    ],
+                    "headline_seeds": per_seed,
+                    "speedup_spread": {
+                        "min": by_speedup[0]["speedup"],
+                        "median": speedup,
+                        "max": by_speedup[-1]["speedup"],
+                    },
                     "qps": round(qps, 2),
                     "service_miss_s": round(t_miss, 4),
                     "service_hit_s": round(t_hit, 4),
                     "readback_rtt_s": round(readback_rtt, 4),
                     "decode_tok_s_per_seq": round(decode_tok_s, 1),
+                    "decode_attention": CFG.decode_attention,
                     "device": jax.devices()[0].platform,
                     "requests": len(requests),
+                    "matrix": matrix,
+                    "mfu": mfu,
+                    "kernels": kernels,
                 },
             }
         )

@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import re
 import shutil
+import tempfile
 import threading
 from concurrent import futures
 from typing import Dict, Optional
@@ -141,8 +142,15 @@ def fetch_tokenizer_files(
         from modelscope import snapshot_download
     else:
         from huggingface_hub import snapshot_download
-    tmp_path = f"{local_path}.tmp-{os.getpid()}"
-    os.makedirs(tmp_path, exist_ok=True)
+    # A UNIQUE temp dir per call (mkdtemp, not a pid suffix): concurrent
+    # fetches of the same model — two RPC threads, or two sidecar
+    # replicas on a shared volume — must never share a staging dir, or
+    # one's rename publishes the other's half-written files.
+    parent = os.path.dirname(local_path)
+    os.makedirs(parent, exist_ok=True)
+    tmp_path = tempfile.mkdtemp(
+        dir=parent, prefix=f".{os.path.basename(local_path)}.tmp-"
+    )
     try:
         snapshot_download(
             model_identifier,
@@ -157,11 +165,14 @@ def fetch_tokenizer_files(
             "modelscope" if use_modelscope else "huggingface",
         )
         raise
-    if os.path.isdir(local_path):  # lost a concurrent-download race
-        shutil.rmtree(tmp_path, ignore_errors=True)
-    else:
-        os.makedirs(os.path.dirname(local_path), exist_ok=True)
+    try:
         os.replace(tmp_path, local_path)
+    except OSError:
+        # Lost the publish race (target created between our cache check
+        # and now, e.g. ENOTEMPTY); the winner's copy serves everyone.
+        shutil.rmtree(tmp_path, ignore_errors=True)
+        if not _is_cached(local_path):
+            raise
     logger.info(
         "downloaded tokenizer files for %s to %s",
         model_identifier,

@@ -1,0 +1,183 @@
+"""Offload-engine throughput benchmark (VERDICT r3 #4).
+
+The NUMA-pinned C++ engine exists to be fast; this measures it.
+Counterpart of the reference connector's throughput calc
+(kv_connectors/llmd_fs_backend/tests/test_fs_backend.py), minus CUDA:
+here the moved bytes are host-RAM KV group buffers, the same shape the
+TPU connector stages (offload/worker.py one-gather-one-DMA groups).
+
+Measures, per engine (native C++ vs Python fallback):
+
+* store GB/s — N group files written via async jobs, wait-harvested;
+* load GB/s — same files read back into preallocated buffers;
+* store GB/s with ``skip_existing`` dedupe hitting resident files.
+
+And the tier latency ladder the manager's scorer weights encode:
+
+* host-tier hit  — HostTierCache.get (DRAM, no syscall);
+* file read      — engine load of one group from the filesystem.
+
+Emits one JSON line; run from repo root:
+
+    python tests/profiling/offload_benchmark.py [--files 64] [--mb 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, str(pathlib.Path(__file__).resolve().parent.parent.parent)
+)
+
+from llm_d_kv_cache_manager_tpu.native import get_library  # noqa: E402
+from llm_d_kv_cache_manager_tpu.native.engine import JobStatus, OffloadEngine
+from llm_d_kv_cache_manager_tpu.offload.host_tier import HostTierCache
+
+
+def run_jobs(engine, direction, paths, buffers, skip_existing=True):
+    """Submit one job per file, wait for all; returns elapsed seconds."""
+    t0 = time.perf_counter()
+    for job_id, (path, buffer) in enumerate(zip(paths, buffers)):
+        if direction == "store":
+            engine.store(job_id, [path], [buffer], skip_existing)
+        else:
+            engine.load(job_id, [path], [buffer])
+    for job_id in range(len(paths)):
+        status = engine.wait(job_id)
+        assert status == JobStatus.SUCCEEDED, f"job {job_id}: {status}"
+    return time.perf_counter() - t0
+
+
+def bench_engine(native: bool, root: str, n_files: int, file_mb: int,
+                 threads: int) -> dict:
+    """GB/s for one engine flavor over its own directory."""
+    if native and get_library() is None:
+        return {"skipped": "native library unavailable"}
+    engine = OffloadEngine(n_threads=threads)
+    if native != engine.is_native:
+        engine.close()
+        return {"skipped": f"wanted native={native}"}
+    try:
+        rng = np.random.default_rng(0)
+        buffers = [
+            rng.integers(0, 255, size=file_mb << 20, dtype=np.uint8)
+            for _ in range(n_files)
+        ]
+        paths = [f"{root}/{i:03d}/blk_{i}.bin" for i in range(n_files)]
+        total_gb = n_files * file_mb / 1024
+
+        store_s = run_jobs(engine, "store", paths, buffers,
+                           skip_existing=False)
+        dedupe_s = run_jobs(engine, "store", paths, buffers,
+                            skip_existing=True)
+        read_bufs = [np.empty_like(b) for b in buffers]
+        load_s = run_jobs(engine, "load", paths, read_bufs)
+        assert all(
+            np.array_equal(a, b) for a, b in zip(buffers, read_bufs)
+        ), "loaded bytes differ from stored bytes"
+        return {
+            "threads": threads,
+            "files": n_files,
+            "file_mb": file_mb,
+            "store_gb_s": round(total_gb / store_s, 3),
+            "load_gb_s": round(total_gb / load_s, 3),
+            "dedupe_store_gb_s": round(total_gb / dedupe_s, 3),
+        }
+    finally:
+        engine.close()
+
+
+def bench_tier_latency(root: str, file_mb: int, reps: int = 50) -> dict:
+    """Host-tier-hit vs file-read latency for ONE group fetch."""
+    group = np.random.default_rng(1).integers(
+        0, 255, size=file_mb << 20, dtype=np.uint8
+    )
+    tier = HostTierCache(max_bytes=group.nbytes * 2)
+    tier.put(0xF00D, group)
+
+    hit_us = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        got = tier.get(0xF00D)
+        hit_us.append((time.perf_counter() - t0) * 1e6)
+        assert got is not None
+
+    engine = OffloadEngine(n_threads=1)
+    path = f"{root}/tier_probe.bin"
+    engine.store(0, [path], [group], skip_existing=False)
+    assert engine.wait(0) == JobStatus.SUCCEEDED
+    out = np.empty_like(group)
+    file_us = []
+    for job_id in range(1, reps + 1):
+        t0 = time.perf_counter()
+        engine.load(job_id, [path], [out])
+        assert engine.wait(job_id) == JobStatus.SUCCEEDED
+        file_us.append((time.perf_counter() - t0) * 1e6)
+    engine.close()
+    return {
+        "group_mb": file_mb,
+        "host_tier_hit_us_p50": round(statistics.median(hit_us), 2),
+        "file_read_us_p50": round(statistics.median(file_us), 2),
+        "file_vs_host_ratio": round(
+            statistics.median(file_us) / max(statistics.median(hit_us), 1e-3),
+            1,
+        ),
+        "engine": "native" if get_library() is not None else "python",
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--files", type=int, default=64)
+    parser.add_argument("--mb", type=int, default=4)
+    parser.add_argument("--threads", type=int, default=8)
+    args = parser.parse_args()
+
+    root = tempfile.mkdtemp(prefix="kvtpu-offload-bench-")
+    try:
+        result = {
+            "bench": "offload_throughput",
+            "native": bench_engine(
+                True, f"{root}/native", args.files, args.mb, args.threads
+            ),
+            "python_fallback": {},
+            "tier_latency": bench_tier_latency(f"{root}/tier", args.mb),
+        }
+        # Force the Python fallback (loader honors this env knob).
+        import os
+
+        os.environ["KVTPU_DISABLE_NATIVE"] = "1"
+        try:
+            result["python_fallback"] = bench_engine(
+                False, f"{root}/python", args.files, args.mb, args.threads
+            )
+        finally:
+            del os.environ["KVTPU_DISABLE_NATIVE"]
+        native = result["native"]
+        fallback = result["python_fallback"]
+        if "store_gb_s" in native and "store_gb_s" in fallback:
+            result["native_vs_python"] = {
+                "store": round(
+                    native["store_gb_s"] / fallback["store_gb_s"], 2
+                ),
+                "load": round(
+                    native["load_gb_s"] / fallback["load_gb_s"], 2
+                ),
+            }
+        print(json.dumps(result))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
