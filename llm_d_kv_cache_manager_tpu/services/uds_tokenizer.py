@@ -151,6 +151,9 @@ def fetch_tokenizer_files(
     tmp_path = tempfile.mkdtemp(
         dir=parent, prefix=f".{os.path.basename(local_path)}.tmp-"
     )
+    # mkdtemp's fixed 0700 would survive os.replace and lock other UIDs
+    # (shared-volume sidecar replicas) out of the published cache dir.
+    os.chmod(tmp_path, 0o755)
     try:
         snapshot_download(
             model_identifier,
