@@ -54,6 +54,15 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+
+# Site hooks may force the tunnel platform via jax.config at interpreter
+# start, where config beats env (see tests/conftest.py).  This knob wins
+# for CI smoke runs and for validating bench logic when no chip is
+# reachable: KVTPU_BENCH_PLATFORM=cpu python bench.py.
+if os.environ.get("KVTPU_BENCH_PLATFORM"):
+    jax.config.update(
+        "jax_platforms", os.environ["KVTPU_BENCH_PLATFORM"]
+    )
 import jax.numpy as jnp
 import numpy as np
 
@@ -308,6 +317,153 @@ class EstimatedScorer:
             assumed.pop(next(iter(assumed)))
 
 
+class FleetRouter:
+    """Routing + engine-cache accounting shared by the real-compute
+    headline runs and the virtual-clock matrix cells.  ONE semantics,
+    measured two ways — were these duplicated, a fix to one path would
+    silently make the headline and the matrix measure different caches.
+
+    Strategies: "precise" runs the real indexer read+write path
+    (routing wall time charged to TTFT); "estimated" routes from
+    scheduler-local affinity; "load" to the least-backlogged pod;
+    "random"/"round_robin" blind.
+    """
+
+    def __init__(
+        self,
+        strategy: str,
+        with_kv: bool,
+        params=None,
+        seed: int = 0,
+    ) -> None:
+        self.strategy = strategy
+        self.pods = [
+            SimPod(f"pod-{i}", params, with_kv=with_kv)
+            for i in range(NUM_PODS)
+        ]
+        self.pod_by_name = {p.name: p for p in self.pods}
+        self.pod_free_at: Dict[str, float] = {
+            p.name: 0.0 for p in self.pods
+        }
+        self.completions: Dict[str, List[float]] = {
+            p.name: [] for p in self.pods
+        }
+        self._rr = 0
+        self._rng = random.Random(31_000 + seed)
+        self.indexer = None
+        self.event_pool = None
+        self.estimated = None
+        if strategy == "precise":
+            self.indexer = Indexer(
+                IndexerConfig(
+                    token_processor_config=TokenProcessorConfig(
+                        block_size=BLOCK_SIZE
+                    ),
+                    kvblock_index_config=IndexConfig(),
+                ),
+                tokenizer=WordTokenizer(),
+            )
+            self.indexer.run()
+            self.event_pool = Pool(
+                self.indexer.kv_block_index,
+                self.indexer.token_processor,
+                PoolConfig(concurrency=2),
+            )
+            self.event_pool.start()
+        elif strategy == "estimated":
+            self.estimated = EstimatedScorer()
+
+    def shutdown(self) -> None:
+        if self.event_pool is not None:
+            self.event_pool.shutdown()
+        if self.indexer is not None:
+            self.indexer.shutdown()
+
+    def _next_rr(self) -> SimPod:
+        pod = self.pods[self._rr % NUM_PODS]
+        self._rr += 1
+        return pod
+
+    def route(
+        self, text: str, hashes: Sequence[int]
+    ) -> Tuple[SimPod, float]:
+        """Pick a pod; returns (pod, routing seconds charged to TTFT)."""
+        if self.strategy == "precise":
+            t0 = time.perf_counter()
+            scores = self.indexer.get_pod_scores(
+                text, MODEL_NAME, [p.name for p in self.pods]
+            )
+            routing_seconds = time.perf_counter() - t0
+            if scores and max(scores.values()) > 0:
+                pod = self.pod_by_name[
+                    max(scores.items(), key=lambda kv: kv[1])[0]
+                ]
+            else:
+                pod = self._next_rr()
+            return pod, routing_seconds
+        if self.strategy == "estimated":
+            name = self.estimated.pick(
+                [p.name for p in self.pods], hashes
+            )
+            return (
+                self.pod_by_name[name] if name else self._next_rr()
+            ), 0.0
+        if self.strategy == "load":
+            return (
+                min(self.pods, key=lambda p: self.pod_free_at[p.name]),
+                0.0,
+            )
+        if self.strategy == "random":
+            return self._rng.choice(self.pods), 0.0
+        return self._next_rr(), 0.0
+
+    @staticmethod
+    def account(
+        pod: SimPod, hashes: Sequence[int]
+    ) -> Tuple[bool, int, List[int], List[int]]:
+        """Engine-side hit check + allocation.  Suffix blocks never
+        repeat across requests, so a hit is exactly the shared prefix;
+        partial-prefix hits count as misses (single compiled suffix
+        shape).  Returns (hit, first_new, block_ids, evicted)."""
+        n_prefix_blocks = PREFIX_TOKENS // BLOCK_SIZE
+        cached_ids = pod.cached_prefix_blocks(hashes)
+        if len(cached_ids) >= n_prefix_blocks:
+            new_ids, evicted = pod.alloc(len(hashes) - n_prefix_blocks)
+            return (
+                True,
+                n_prefix_blocks,
+                cached_ids[:n_prefix_blocks] + new_ids,
+                evicted,
+            )
+        new_ids, evicted = pod.alloc(len(hashes))
+        return False, 0, new_ids, evicted
+
+    def commit(
+        self,
+        pod: SimPod,
+        tokens: Sequence[int],
+        hashes: Sequence[int],
+        first_new: int,
+        block_ids: Sequence[int],
+        evicted: Sequence[int],
+    ) -> None:
+        """Register ONLY newly-written blocks: re-registering a hit
+        prefix would resurrect hashes that alloc() just evicted when
+        the allocator wrapped into the cached prefix region, mapping
+        them to blocks that now hold suffix KV.  Then feed whichever
+        learning mechanism the strategy uses."""
+        for h, bid in zip(hashes[first_new:], block_ids[first_new:]):
+            pod.cached[h] = bid
+            pod._block_owner[bid] = h
+        if self.event_pool is not None:
+            publish_events(
+                self.event_pool, pod, tokens, hashes, first_new, evicted
+            )
+            self.event_pool.drain()  # index learns before next arrival
+        elif self.estimated is not None:
+            self.estimated.record(pod.name, hashes)
+
+
 def run_fleet_virtual(
     strategy: str,
     requests,
@@ -319,120 +475,36 @@ def run_fleet_virtual(
 ) -> Tuple[List[float], float, float]:
     """One matrix cell: the request stream under ``strategy`` on the
     virtual clock, service times taken from the measured on-device
-    prefill costs.  Returns (TTFTs, hit rate, mean queue depth).
-
-    The "precise" strategy runs the REAL indexer read+write path per
-    request (tokenize -> chained hashes -> lookup -> score, plus the
-    event-pool write path); its routing time is measured wall clock and
-    charged to TTFT.  The other strategies route without the indexer:
-    "estimated" from scheduler-local affinity, "load" to the
-    least-backlogged pod, "random"/"round_robin" blind.
-    """
-    indexer = event_pool = None
-    estimated = None
-    rng = random.Random(31_000 + seed)
-    if strategy == "precise":
-        indexer = Indexer(
-            IndexerConfig(
-                token_processor_config=TokenProcessorConfig(
-                    block_size=BLOCK_SIZE
-                ),
-                kvblock_index_config=IndexConfig(),
-            ),
-            tokenizer=WordTokenizer(),
-        )
-        indexer.run()
-        event_pool = Pool(
-            indexer.kv_block_index,
-            indexer.token_processor,
-            PoolConfig(concurrency=2),
-        )
-        event_pool.start()
-    elif strategy == "estimated":
-        estimated = EstimatedScorer()
-
-    pods = [SimPod(f"pod-{i}", with_kv=False) for i in range(NUM_PODS)]
-    pod_by_name = {p.name: p for p in pods}
-    n_prefix_blocks = PREFIX_TOKENS // BLOCK_SIZE
-
+    prefill costs.  Returns (TTFTs, hit rate, mean queue depth)."""
+    fleet = FleetRouter(strategy, with_kv=False, seed=seed)
     ttfts: List[float] = []
     depths: List[int] = []
     hits = 0
-    rr_next = 0
-    pod_free_at = {p.name: 0.0 for p in pods}
-    completions: Dict[str, List[float]] = {p.name: [] for p in pods}
     try:
         for ((group, text, tokens), hashes, arrival) in zip(
             requests, hashes_list, arrivals
         ):
-            routing_seconds = 0.0
-            if strategy == "precise":
-                t0 = time.perf_counter()
-                scores = indexer.get_pod_scores(
-                    text, MODEL_NAME, [p.name for p in pods]
-                )
-                routing_seconds = time.perf_counter() - t0
-                if scores and max(scores.values()) > 0:
-                    pod = pod_by_name[
-                        max(scores.items(), key=lambda kv: kv[1])[0]
-                    ]
-                else:
-                    pod = pods[rr_next % NUM_PODS]
-                    rr_next += 1
-            elif strategy == "estimated":
-                name = estimated.pick([p.name for p in pods], hashes)
-                if name is None:
-                    pod = pods[rr_next % NUM_PODS]
-                    rr_next += 1
-                else:
-                    pod = pod_by_name[name]
-            elif strategy == "load":
-                pod = min(pods, key=lambda p: (pod_free_at[p.name]))
-            elif strategy == "random":
-                pod = rng.choice(pods)
-            else:  # round_robin
-                pod = pods[rr_next % NUM_PODS]
-                rr_next += 1
-
-            cached_ids = pod.cached_prefix_blocks(hashes)
-            hit = len(cached_ids) >= n_prefix_blocks
-            if hit:
-                hits += 1
-                new_ids, evicted = pod.alloc(len(hashes) - n_prefix_blocks)
-                first_new = n_prefix_blocks
-                block_ids = cached_ids[:n_prefix_blocks] + new_ids
-            else:
-                new_ids, evicted = pod.alloc(len(hashes))
-                first_new = 0
-                block_ids = new_ids
-            service_seconds = t_hit if hit else t_miss
-
-            depths.append(
-                sum(1 for c in completions[pod.name] if c > arrival)
+            pod, routing_seconds = fleet.route(text, hashes)
+            hit, first_new, block_ids, evicted = fleet.account(
+                pod, hashes
             )
-            queue_start = max(arrival, pod_free_at[pod.name])
+            hits += hit
+            service_seconds = t_hit if hit else t_miss
+            depths.append(
+                sum(1 for c in fleet.completions[pod.name] if c > arrival)
+            )
+            queue_start = max(arrival, fleet.pod_free_at[pod.name])
             done = queue_start + service_seconds
-            pod_free_at[pod.name] = done
-            completions[pod.name].append(done)
+            fleet.pod_free_at[pod.name] = done
+            fleet.completions[pod.name].append(done)
             ttfts.append(
                 routing_seconds + (queue_start - arrival) + service_seconds
             )
-
-            for h, bid in zip(hashes[first_new:], block_ids[first_new:]):
-                pod.cached[h] = bid
-                pod._block_owner[bid] = h
-            if strategy == "precise":
-                publish_events(
-                    event_pool, pod, tokens, hashes, first_new, evicted
-                )
-                event_pool.drain()
-            elif strategy == "estimated":
-                estimated.record(pod.name, hashes)
+            fleet.commit(
+                pod, tokens, hashes, first_new, block_ids, evicted
+            )
     finally:
-        if event_pool is not None:
-            event_pool.shutdown()
-        if indexer is not None:
-            indexer.shutdown()
+        fleet.shutdown()
     return ttfts, hits / len(requests), float(np.mean(depths))
 
 
@@ -475,108 +547,49 @@ def run_fleet(
     time is the service time; queueing is then
     ``start = max(arrival, pod_free_at)`` and
     ``TTFT = routing + (start - arrival) + service``."""
-    indexer = Indexer(
-        IndexerConfig(
-            token_processor_config=TokenProcessorConfig(
-                block_size=BLOCK_SIZE
-            ),
-            kvblock_index_config=IndexConfig(),
-        ),
-        tokenizer=WordTokenizer(),
-    )
-    indexer.run()
-    event_pool = Pool(
-        indexer.kv_block_index,
-        indexer.token_processor,
-        PoolConfig(concurrency=2),
-    )
-    event_pool.start()
-    pods = [SimPod(f"pod-{i}", params) for i in range(NUM_PODS)]
-    pod_by_name = {p.name: p for p in pods}
-
+    fleet = FleetRouter(scheduler, with_kv=True, params=params)
     ttfts: List[float] = []
     hits = 0
-    rr_next = 0
-    pod_free_at = {p.name: 0.0 for p in pods}
     try:
         for (group, text, tokens), arrival in zip(requests, arrivals):
-            t0 = time.perf_counter()
-            if scheduler == "precise":
-                scores = indexer.get_pod_scores(
-                    text, MODEL_NAME, [p.name for p in pods]
-                )
-                best = max(scores.values()) if scores else 0.0
-                if best > 0:
-                    pod = pod_by_name[
-                        max(scores.items(), key=lambda kv: kv[1])[0]
-                    ]
-                else:
-                    pod = pods[rr_next % NUM_PODS]
-                    rr_next += 1
-            else:
-                pod = pods[rr_next % NUM_PODS]
-                rr_next += 1
-
-            routing_seconds = time.perf_counter() - t0
-
             hashes = block_hash_chain(tokens)
-            cached_ids = pod.cached_prefix_blocks(hashes)
-            # Suffix blocks never repeat across requests, so a hit is
-            # exactly the shared prefix; treat partial-prefix hits as
-            # misses (single compiled suffix shape).
-            n_prefix_blocks = PREFIX_TOKENS // BLOCK_SIZE
+            pod, routing_seconds = fleet.route(text, hashes)
+            hit, first_new, block_ids, evicted = fleet.account(
+                pod, hashes
+            )
+            hits += hit
             token_arr = np.asarray(tokens, np.int32)
+            table = jnp.asarray([block_ids], jnp.int32)
             service_start = time.perf_counter()
-            if len(cached_ids) >= n_prefix_blocks:
-                hits += 1
-                new_ids, evicted = pod.alloc(len(hashes) - n_prefix_blocks)
-                table = jnp.asarray(
-                    [cached_ids[:n_prefix_blocks] + new_ids], jnp.int32
-                )
+            if hit:
                 logits, pod.kv = prefill_suffix(
                     pod.params,
                     jnp.asarray(token_arr[None, PREFIX_TOKENS:]),
                     pod.kv,
                     table,
                 )
-                first_new = n_prefix_blocks
-                block_ids = cached_ids[:n_prefix_blocks] + new_ids
             else:
-                new_ids, evicted = pod.alloc(len(hashes))
-                table = jnp.asarray([new_ids], jnp.int32)
                 logits, pod.kv = prefill_full(
                     pod.params, jnp.asarray(token_arr[None]), pod.kv, table
                 )
-                first_new = 0
-                block_ids = new_ids
             # Service ends when the first sampled token reaches the host
             # (the same on-device argmax + readback both paths).
             int(jnp.argmax(logits[0, -1]))
             service_seconds = max(
                 time.perf_counter() - service_start - readback_rtt, 1e-4
             )
-            queue_start = max(arrival, pod_free_at[pod.name])
-            pod_free_at[pod.name] = queue_start + service_seconds
+            queue_start = max(arrival, fleet.pod_free_at[pod.name])
+            fleet.pod_free_at[pod.name] = queue_start + service_seconds
             ttfts.append(
                 routing_seconds
                 + (queue_start - arrival)
                 + service_seconds
             )
-
-            # Register only newly-written blocks: re-registering the hit
-            # prefix would resurrect hashes that alloc() just evicted when
-            # the allocator wrapped into the cached prefix region, mapping
-            # them to blocks that now hold suffix KV.
-            for h, bid in zip(hashes[first_new:], block_ids[first_new:]):
-                pod.cached[h] = bid
-                pod._block_owner[bid] = h
-            publish_events(
-                event_pool, pod, tokens, hashes, first_new, evicted
+            fleet.commit(
+                pod, tokens, hashes, first_new, block_ids, evicted
             )
-            event_pool.drain()  # index learns before the next arrival
     finally:
-        event_pool.shutdown()
-        indexer.shutdown()
+        fleet.shutdown()
     return ttfts, hits / len(requests)
 
 
