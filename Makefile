@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test unit-test e2e-test examples bench native proto graft-check chart clean
+.PHONY: all test unit-test e2e-test examples bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -35,6 +35,11 @@ chart:
 # Build the native C++ engine in-tree.
 native:
 	$(PYTHON) -m llm_d_kv_cache_manager_tpu.native.build
+
+# ThreadSanitizer stress of the native engine (race detection the
+# reference never wired up; SURVEY.md §5).
+native-race:
+	$(PYTHON) -m llm_d_kv_cache_manager_tpu.native.build --stress-tsan
 
 # Regenerate protobuf message code (grpc wiring is hand-written,
 # api/grpc_services.py).

@@ -70,7 +70,51 @@ def build(force: bool = False) -> str | None:
     return lib
 
 
+def build_stress(tsan: bool = False) -> str | None:
+    """Compile the TSan stress harness (src/stress_main.cpp); returns
+    the binary path, or None if no compiler.  With ``tsan=True`` the
+    whole engine is instrumented with ThreadSanitizer — the race
+    detection SURVEY.md §5 notes the reference never wired up."""
+    here, src_dir, _ = _paths()
+    compiler = shutil.which("g++") or shutil.which("c++")
+    if compiler is None:
+        return None
+    out = os.path.join(here, "stress_tsan" if tsan else "stress")
+    sources = [os.path.join(src_dir, f) for f in _SRC_FILES]
+    sources.append(os.path.join(src_dir, "stress_main.cpp"))
+    cmd = [compiler, "-std=c++17", "-pthread", "-Wall", "-Wextra"]
+    if tsan:
+        cmd += ["-fsanitize=thread", "-O1", "-g"]
+    else:
+        cmd += ["-O2"]
+    # Temp-then-rename like build(): concurrent builders (parallel test
+    # workers) must never exec a torn or ETXTBSY-blocked binary.
+    fd, tmp = tempfile.mkstemp(prefix="stress.", dir=here)
+    os.close(fd)
+    cmd += ["-o", tmp, *sources]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.chmod(tmp, 0o755)
+        os.replace(tmp, out)
+    except subprocess.CalledProcessError as exc:
+        os.unlink(tmp)
+        raise RuntimeError(f"stress build failed:\n{exc.stderr}") from exc
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return out
+
+
 if __name__ == "__main__":
+    if "--stress" in sys.argv or "--stress-tsan" in sys.argv:
+        binary = build_stress(tsan="--stress-tsan" in sys.argv)
+        if binary is None:
+            print("no C++ compiler found")
+            sys.exit(1)
+        print(f"built {binary}; running")
+        env = dict(os.environ, TSAN_OPTIONS="halt_on_error=1")
+        sys.exit(subprocess.run([binary], env=env).returncode)
     result = build(force="--force" in sys.argv)
     if result is None:
         print("no C++ compiler found; pure-Python fallback will be used")

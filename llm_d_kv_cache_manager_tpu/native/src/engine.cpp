@@ -120,11 +120,17 @@ JobStatus OffloadEngine::wait(int64_t job_id) {
     job = it->second;
   }
   job->done_future.wait();
+  // Exactly-once claim: a concurrent get_finished() poller may have
+  // harvested (erased) the job between our lookup and the future
+  // firing.  Only the claimant that removes the map entry reports the
+  // status; the loser sees kUnknown, exactly as if it had arrived
+  // after the harvest.  (The TSan stress harness, stress_main.cpp,
+  // caught the pre-fix double-report.)
   JobStatus status = job->failed.load() == 0 ? JobStatus::kSucceeded
                                              : JobStatus::kFailed;
   {
     std::lock_guard<std::mutex> lock(jobs_mu_);
-    jobs_.erase(job_id);
+    if (jobs_.erase(job_id) == 0) return JobStatus::kUnknown;
   }
   return status;
 }

@@ -90,8 +90,12 @@ class TestFleetRouterSemantics:
     def test_round_robin_cycles(self):
         fleet = self._router("round_robin")
         try:
-            pods = [fleet.route("", [1])[0].name for _ in range(8)]
-            assert pods[:4] == sorted(set(pods)) and pods[:4] == pods[4:]
+            n = bench.NUM_PODS
+            pods = [
+                fleet.route("", [1])[0].name for _ in range(2 * n)
+            ]
+            assert pods[:n] == sorted(set(pods))
+            assert pods[:n] == pods[n:]
         finally:
             fleet.shutdown()
 
@@ -173,7 +177,9 @@ class TestVirtualClock:
             t_hit=0.1,
             seed=0,
         )
-        assert ttfts[: bench.NUM_PODS] == pytest.approx([1.0] * 4)
+        assert ttfts[: bench.NUM_PODS] == pytest.approx(
+            [1.0] * bench.NUM_PODS
+        )
         assert ttfts[-1] == pytest.approx(1.0 + 0.1)
         assert depth > 0
 
