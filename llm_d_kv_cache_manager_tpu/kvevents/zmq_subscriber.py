@@ -26,6 +26,7 @@ from typing import Callable, Optional
 import zmq
 
 from llm_d_kv_cache_manager_tpu.kvevents.pool import Message
+from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
 
 logger = get_logger("kvevents.zmq")
@@ -167,13 +168,15 @@ class ZMQSubscriber:
             seq = struct.unpack(">Q", seq_raw)[0]
             last_seq = self._last_seq_by_topic.get(topic)
             if last_seq is not None and seq > last_seq + 1:
-                self.gap_count += seq - last_seq - 1
+                lost = seq - last_seq - 1
+                self.gap_count += lost
+                METRICS.kvevents_seq_gaps.labels(pod=pod_id).inc(lost)
                 logger.warning(
                     "sequence gap on %s: %d -> %d (%d events lost)",
                     topic,
                     last_seq,
                     seq,
-                    seq - last_seq - 1,
+                    lost,
                 )
             self._last_seq_by_topic[topic] = seq
 

@@ -81,6 +81,12 @@ class KVCacheMetrics:
             ("reason",),
             registry=self.registry,
         )
+        self.kvevents_seq_gaps = Counter(
+            f"{_NAMESPACE}_kvevents_seq_gaps_total",
+            "Events lost to publisher sequence-number gaps, by pod.",
+            ("pod",),
+            registry=self.registry,
+        )
         self.offload_bytes = Counter(
             f"{_NAMESPACE}_offload_bytes_total",
             "Bytes moved by the offload engine by direction.",

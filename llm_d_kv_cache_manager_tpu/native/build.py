@@ -113,8 +113,17 @@ if __name__ == "__main__":
             print("no C++ compiler found")
             sys.exit(1)
         print(f"built {binary}; running")
-        env = dict(os.environ, TSAN_OPTIONS="halt_on_error=1")
-        sys.exit(subprocess.run([binary], env=env).returncode)
+        # Scratch dir cleaned up after the run (repeated `make
+        # native-race` must not accumulate ~26 MB per run in /tmp).
+        with tempfile.TemporaryDirectory(
+            prefix="kvtpu-stress-"
+        ) as scratch:
+            env = dict(
+                os.environ,
+                TSAN_OPTIONS="halt_on_error=1",
+                KVTPU_STRESS_DIR=scratch,
+            )
+            sys.exit(subprocess.run([binary], env=env).returncode)
     result = build(force="--force" in sys.argv)
     if result is None:
         print("no C++ compiler found; pure-Python fallback will be used")

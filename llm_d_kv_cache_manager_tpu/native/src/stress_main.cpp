@@ -57,7 +57,6 @@ int main() {
     buffers[p].assign(kBufBytes, static_cast<uint8_t>(p + 1));
   }
 
-  std::atomic<int> stores_done{0};
   std::atomic<bool> stop_polling{false};
   std::atomic<int> harvested{0};
   std::atomic<int> failed{0};
@@ -107,7 +106,6 @@ int main() {
               harvested.fetch_add(1);
           }
         }
-        stores_done.fetch_add(1);
       }
     });
   }

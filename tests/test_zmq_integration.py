@@ -37,6 +37,50 @@ def test_parse_topic():
     assert parse_topic("kv@@model") is None
 
 
+def test_sequence_gap_counted_and_metered():
+    """Lost publisher events surface in gap_count AND the Prometheus
+    counter (kvtpu_kvevents_seq_gaps_total{pod=...}) so operators can
+    alert on event loss (improves on the reference, which parses seq
+    but ignores it — zmq_subscriber.go:143)."""
+    import struct
+
+    from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+        ZMQSubscriber,
+        ZMQSubscriberConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+    def metric_value():
+        for metric in METRICS.kvevents_seq_gaps.collect():
+            for sample in metric.samples:
+                if (
+                    sample.name.endswith("_total")
+                    and sample.labels.get("pod") == "gap-pod"
+                ):
+                    return sample.value
+        return 0.0
+
+    sub = ZMQSubscriber(
+        ZMQSubscriberConfig(
+            pod_identifier="gap-pod", endpoint="tcp://127.0.0.1:1"
+        ),
+        sink=lambda message: None,
+    )
+    before = metric_value()
+
+    def deliver(seq):
+        return sub._parse_message(
+            [b"kv@gap-pod@m", struct.pack(">Q", seq), b"payload"]
+        )
+
+    assert deliver(1) is not None
+    assert deliver(2) is not None
+    assert sub.gap_count == 0
+    assert deliver(5) is not None  # 3 and 4 lost
+    assert sub.gap_count == 2
+    assert metric_value() - before == 2.0
+
+
 class TestSubscriberManagerLifecycle:
     def test_lifecycle_without_publishers(self):
         manager = SubscriberManager(sink=lambda m: None)
