@@ -723,21 +723,36 @@ def bench_kernels(readback_rtt: float) -> dict:
     )
     ctx = jnp.full((B,), TOTAL_TOKENS, jnp.int32)
 
-    decode_err = max_rel_err(
-        paged_decode_attention_pallas(q, kv_layer, table, ctx),
-        paged_attention(q, kv_layer, table, ctx),
-    )
-    assert decode_err < 0.05, (
-        f"paged-decode Pallas/XLA diverge: max rel err {decode_err:.4f}"
-    )
+    xla_out = paged_attention(q, kv_layer, table, ctx)
     # Decode is sub-ms per call: long chains lift the measurement well
-    # above the tunnel's RTT jitter.
-    t_decode_pallas = time_chained(
-        lambda qq: paged_decode_attention_pallas(qq, kv_layer, table, ctx),
-        q,
-        readback_rtt,
-        steps=96,
-    )
+    # above the tunnel's RTT jitter.  Sweep the kernel's blocks-per-
+    # step tile (r3 review: BLOCKS_PER_STEP=4 was tuned by anecdote);
+    # every candidate must pass the equality gate before it may win.
+    sweep = {}
+    best_p, t_decode_pallas, decode_err = None, float("inf"), 1.0
+    for blocks_per_step in (2, 4, 8):
+        err = max_rel_err(
+            paged_decode_attention_pallas(
+                q, kv_layer, table, ctx,
+                blocks_per_step=blocks_per_step,
+            ),
+            xla_out,
+        )
+        assert err < 0.05, (
+            f"paged-decode Pallas (P={blocks_per_step}) diverges from "
+            f"XLA: max rel err {err:.4f}"
+        )
+        t = time_chained(
+            lambda qq, p=blocks_per_step: paged_decode_attention_pallas(
+                qq, kv_layer, table, ctx, blocks_per_step=p
+            ),
+            q,
+            readback_rtt,
+            steps=96,
+        )
+        sweep[f"P{blocks_per_step}_us"] = round(t * 1e6, 1)
+        if t < t_decode_pallas:
+            best_p, t_decode_pallas, decode_err = blocks_per_step, t, err
     t_decode_xla = time_chained(
         lambda qq: paged_attention(qq, kv_layer, table, ctx),
         q,
@@ -776,6 +791,8 @@ def bench_kernels(readback_rtt: float) -> dict:
             "speedup_pallas": round(t_decode_xla / t_decode_pallas, 2),
             "max_rel_err": round(decode_err, 5),
             "winner": decode_winner,
+            "blocks_per_step_sweep": sweep,
+            "blocks_per_step": best_p,
         },
         "flash_prefill": {
             "shape": f"B=1 T={Tq} H={H} D={Dh}",
@@ -1012,6 +1029,9 @@ def main() -> None:
     decode_winner = kernels.get("paged_decode", {}).get("winner")
     if decode_winner:
         CFG.decode_attention = decode_winner
+        CFG.decode_blocks_per_step = kernels["paged_decode"][
+            "blocks_per_step"
+        ]
 
     # Secondary metric: decode throughput over the warm pod's full
     # 8448-token context (the reference's output-tok/s axis; decode

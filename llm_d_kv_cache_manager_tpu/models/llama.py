@@ -62,6 +62,10 @@ class LlamaConfig:
     # "gather" force one path (bench.py measures both on the real chip
     # and this is the knob to act on the result).
     decode_attention: str = "auto"
+    # Pool blocks the Pallas decode kernel fetches per grid step;
+    # bench.py detail.kernels sweeps this at serving shapes and routes
+    # the measured winner here.
+    decode_blocks_per_step: int = 4
 
     @property
     def head_dim(self) -> int:
@@ -384,7 +388,11 @@ def decode_step(
         )
         if use_pallas:
             attn = paged_decode_attention_pallas(
-                q[:, 0], kv_layer, block_table, context_len
+                q[:, 0],
+                kv_layer,
+                block_table,
+                context_len,
+                blocks_per_step=cfg.decode_blocks_per_step,
             )
         else:
             attn = paged_attention(

@@ -29,9 +29,11 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-# Pool blocks fetched per grid step: amortizes per-step pipeline
-# overhead (528 one-block steps left the MXU mostly idle) while each
-# block still arrives through its own independently-pipelined DMA.
+# Default pool blocks fetched per grid step: amortizes per-step
+# pipeline overhead (528 one-block steps left the MXU mostly idle)
+# while each block still arrives through its own independently-
+# pipelined DMA.  bench.py's detail.kernels sweeps this on the real
+# chip and routes the winner via LlamaConfig.decode_blocks_per_step.
 BLOCKS_PER_STEP = 4
 
 
@@ -39,14 +41,15 @@ def _decode_kernel(
     table_ref,  # SMEM [B, max_blocks] int32 (scalar prefetch)
     ctx_ref,  # SMEM [B] int32 (scalar prefetch)
     q_ref,  # VMEM [1, H, D]
-    *rest,  # BLOCKS_PER_STEP kv refs, out ref, then scratch
+    *rest,  # blocks_per_step kv refs, out ref, then scratch
     block_size: int,
     groups: int,
     scale: float,
+    blocks_per_step: int,
 ):
-    kv_refs = rest[:BLOCKS_PER_STEP]
-    out_ref = rest[BLOCKS_PER_STEP]
-    m_ref, l_ref, acc_ref = rest[BLOCKS_PER_STEP + 1 :]
+    kv_refs = rest[:blocks_per_step]
+    out_ref = rest[blocks_per_step]
+    m_ref, l_ref, acc_ref = rest[blocks_per_step + 1 :]
 
     b = pl.program_id(0)
     j = pl.program_id(1)
@@ -67,7 +70,7 @@ def _decode_kernel(
 
     for i, kv_ref in enumerate(kv_refs):
         # Valid positions in sub-block i: [(j*P+i)*bs, ctx).
-        valid = ctx - (j * BLOCKS_PER_STEP + i) * block_size
+        valid = ctx - (j * blocks_per_step + i) * block_size
 
         @pl.when(valid > 0)
         def _attend(kv_ref=kv_ref, valid=valid):
@@ -113,7 +116,9 @@ def _decode_kernel(
         out_ref[0] = out.astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "blocks_per_step")
+)
 def paged_decode_attention_pallas(
     q: jnp.ndarray,
     kv_layer: jnp.ndarray,
@@ -121,6 +126,7 @@ def paged_decode_attention_pallas(
     context_len: jnp.ndarray,
     *,
     interpret: bool = False,
+    blocks_per_step: int = BLOCKS_PER_STEP,
 ) -> jnp.ndarray:
     """q: [B, H, D]; kv_layer: [num_blocks, 2, bs, Hkv, D];
     block_table: [B, max_blocks] int32; context_len: [B] int32.
@@ -129,7 +135,7 @@ def paged_decode_attention_pallas(
     _, _, block_size, Hkv, _ = kv_layer.shape
     groups = H // Hkv
     max_blocks = block_table.shape[1]
-    P_STEP = BLOCKS_PER_STEP
+    P_STEP = blocks_per_step
     n_steps = -(-max_blocks // P_STEP)
     if max_blocks % P_STEP:
         # Pad table columns; pads resolve to the last valid block and
@@ -185,6 +191,7 @@ def paged_decode_attention_pallas(
         block_size=block_size,
         groups=groups,
         scale=D**-0.5,
+        blocks_per_step=P_STEP,
     )
     return pl.pallas_call(
         kernel,
@@ -195,5 +202,5 @@ def paged_decode_attention_pallas(
         block_table.astype(jnp.int32),
         context_len.astype(jnp.int32),
         q,
-        *([kv_layer] * BLOCKS_PER_STEP),
+        *([kv_layer] * P_STEP),
     )

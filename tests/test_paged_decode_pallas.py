@@ -55,6 +55,28 @@ def test_matches_xla_gather(B, H, Hkv, D, max_blocks, ctx):
     )
 
 
+@pytest.mark.parametrize("blocks_per_step", [1, 2, 8])
+def test_blocks_per_step_variants_match(blocks_per_step):
+    """The tile size bench.py sweeps on the chip must be correctness-
+    neutral at every value (ragged contexts + non-divisible tables)."""
+    bs = 16
+    q, kv, table, ctx_arr = make_case(
+        jax.random.PRNGKey(2), 2, 8, 4, 64, 64, bs, 7, [97, 33]
+    )
+    ref = paged_attention(q, kv, table, ctx_arr)
+    got = paged_decode_attention_pallas(
+        q, kv, table, ctx_arr,
+        interpret=True,
+        blocks_per_step=blocks_per_step,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32),
+        np.asarray(ref, np.float32),
+        rtol=0.05,
+        atol=0.05,
+    )
+
+
 def test_context_one_token():
     """ctx=1: only the first slot of the first block is visible."""
     bs = 16
