@@ -1,0 +1,110 @@
+"""Tokenization-path microbenchmarks.
+
+Counterpart of the reference's `make bench` Go benchmarks (chat
+templating + tokenization, Makefile:214-219 there): measures the three
+costs on the scoring hot path — full tokenization, the prefix-store
+fast path that usually replaces it, and chat-template rendering.
+
+Run from the repo root:
+
+    python tests/profiling/tokenization_benchmark.py [--chars 40000]
+
+One JSON line with per-op latencies and the fast-path speedup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import statistics
+import sys
+import time
+
+sys.path.insert(
+    0, str(pathlib.Path(__file__).resolve().parent.parent.parent)
+)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from helpers.tiny_tokenizer import (  # noqa: E402
+    build_transformers_tokenizer,
+)
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (  # noqa: E402,E501
+    ApplyChatTemplateRequest,
+    ChatTemplatingProcessor,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.lru_store import (  # noqa: E402,E501
+    LRUStoreConfig,
+    LRUTokenStore,
+)
+
+MODEL = "bench-model"
+
+
+def timed(fn, reps=30):
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return round(statistics.median(samples), 3)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--chars", type=int, default=40_000)
+    args = parser.parse_args()
+
+    tokenizer = build_transformers_tokenizer()
+    sentence = "the quick brown fox jumps over the lazy dog . "
+    prompt = sentence * (args.chars // len(sentence))
+
+    def full_tokenize():
+        return tokenizer(
+            prompt, add_special_tokens=True, return_offsets_mapping=True
+        )
+
+    encoding = full_tokenize()
+    tokens = list(encoding["input_ids"])
+    offsets = list(encoding["offset_mapping"])
+
+    store = LRUTokenStore(LRUStoreConfig())
+    store.add_tokenization(prompt, tokens, offsets, MODEL)
+
+    def fast_path():
+        return store.find_longest_contained_tokens(prompt, MODEL)
+
+    cached_tokens, ratio = fast_path()
+
+    chat = ChatTemplatingProcessor()
+    chat.register_tokenizer(MODEL, tokenizer)
+    render_req = ApplyChatTemplateRequest(
+        conversation=[
+            {"role": "system", "content": sentence * 40},
+            {"role": "user", "content": sentence * 4},
+        ]
+    )
+
+    full_ms = timed(full_tokenize)
+    fast_ms = timed(fast_path)
+    render_ms = timed(
+        lambda: chat.apply_chat_template(MODEL, render_req)
+    )
+    print(
+        json.dumps(
+            {
+                "bench": "tokenization",
+                "prompt_chars": len(prompt),
+                "prompt_tokens": len(tokens),
+                "full_tokenize_ms": full_ms,
+                "prefix_store_lookup_ms": fast_ms,
+                "fast_path_speedup": round(full_ms / max(fast_ms, 1e-6), 1),
+                "prefix_store_coverage": round(ratio, 4),
+                "chat_render_ms": render_ms,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
