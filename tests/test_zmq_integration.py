@@ -8,9 +8,6 @@ loopback TCP in-process.
 
 import time
 
-import pytest
-import zmq
-
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
     EMPTY_BLOCK_HASH,
     ChunkedTokenDatabase,
