@@ -58,6 +58,7 @@ LOCAL_TOP_LEVELS = {
     "examples",
     "hack",
     "render_chart",  # hack/render_chart.py imported by test_chart.py
+    "helpers",  # tests/helpers, sys.path'd by profiling scripts
     "bench",
     "__graft_entry__",
 }
