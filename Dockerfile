@@ -10,11 +10,13 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
     rm -rf /var/lib/apt/lists/*
 
 WORKDIR /app
-RUN pip install --no-cache-dir \
-        "jax[cpu]" numpy msgpack pyzmq grpcio protobuf \
-        prometheus-client transformers tokenizers
+# jax[cpu] first: the pyproject dependency is plain "jax" (TPU hosts
+# bring their own accelerator build); the control-plane image pins CPU.
+RUN pip install --no-cache-dir "jax[cpu]"
 
+COPY pyproject.toml README.md ./
 COPY llm_d_kv_cache_manager_tpu ./llm_d_kv_cache_manager_tpu
+RUN pip install --no-cache-dir .
 # Build the native engine (hash fast path + offload I/O pool) in-tree.
 RUN python -m llm_d_kv_cache_manager_tpu.native.build
 
