@@ -20,7 +20,6 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
-    EMPTY_BLOCK_HASH,
     TokenProcessorConfig,
 )
 from llm_d_kv_cache_manager_tpu.kvevents.events import BlockRemoved, BlockStored

@@ -20,7 +20,7 @@ reference's legacy-format handling (process_event_test.go:38-60).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Union
 
 import msgpack

@@ -13,7 +13,7 @@ device mesh instead of torch.distributed world info.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import KVCachePool

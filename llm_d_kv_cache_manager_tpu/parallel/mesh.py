@@ -24,7 +24,7 @@ pods over the datacenter network while NCCL stays intra-pod
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax

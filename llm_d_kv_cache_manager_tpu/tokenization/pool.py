@@ -19,7 +19,7 @@ from __future__ import annotations
 import queue
 import threading
 from concurrent.futures import Future
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
 
 from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (

@@ -1,7 +1,6 @@
 """Checkpoint/resume: round trip, sharded restore, resume-training."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P

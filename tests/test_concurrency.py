@@ -12,7 +12,6 @@ import random
 import threading
 import time
 
-import pytest
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
     InMemoryIndex,

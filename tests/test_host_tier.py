@@ -3,7 +3,6 @@
 import os
 
 import numpy as np
-import pytest
 
 from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import (
     KVCachePool,

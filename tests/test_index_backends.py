@@ -8,7 +8,6 @@ backends must be interchangeable.
 import pytest
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
-    EMPTY_BLOCK_HASH,
     IndexConfig,
     PodEntry,
     new_index,

@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from llm_d_kv_cache_manager_tpu.models import moe
 from llm_d_kv_cache_manager_tpu.parallel.mesh import MeshPlan, make_mesh

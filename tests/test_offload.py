@@ -12,9 +12,6 @@ from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import (
 )
 from llm_d_kv_cache_manager_tpu.native.engine import JobStatus
 from llm_d_kv_cache_manager_tpu.offload.file_mapper import FileMapper
-from llm_d_kv_cache_manager_tpu.offload.manager import (
-    SharedStorageOffloadManager,
-)
 from llm_d_kv_cache_manager_tpu.offload.spec import (
     TPUOffloadConnector,
     TPUOffloadSpec,

@@ -20,7 +20,6 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
     TokenProcessorConfig,
 )
 from llm_d_kv_cache_manager_tpu.kvevents.pod_reconciler import (
-    KubeClient,
     PodReconciler,
     PodReconcilerConfig,
 )

@@ -17,7 +17,6 @@ from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.trie_store import (
 )
 from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
     CompositeTokenizer,
-    Encoding,
     LocalFastTokenizer,
     char_offsets_to_byte_offsets,
 )
