@@ -145,3 +145,25 @@ class CostAwareMemoryIndex(Index):
         if request_key is None:
             raise KeyError(f"engine key not found: {engine_key:#x}")
         return request_key
+
+    def purge_pod(self, pod_identifier: str) -> int:
+        removed = 0
+        with self._lock:
+            for request_key in list(self._data):
+                pods = self._data[request_key]
+                victims = [
+                    entry
+                    for entry in pods
+                    if entry.pod_identifier == pod_identifier
+                ]
+                for entry in victims:
+                    self._cost -= pods.pop(entry)
+                removed += len(victims)
+                if not pods:
+                    del self._data[request_key]
+                    self._cost -= _KEY_OVERHEAD
+                    for ek in self._request_to_engines.pop(
+                        request_key, ()
+                    ):
+                        self._engine_to_request.pop(ek, None)
+        return removed

@@ -128,3 +128,30 @@ class InMemoryIndex(Index):
         if request_key is None:
             raise KeyError(f"engine key not found: {engine_key:#x}")
         return request_key
+
+    def purge_pod(self, pod_identifier: str) -> int:
+        removed = 0
+        for request_key in self._data.keys():
+            pod_cache = self._data.get(request_key)
+            if pod_cache is None:  # raced with LRU eviction
+                continue
+            with pod_cache.lock:
+                victims = [
+                    entry
+                    for entry in pod_cache.entries.keys()
+                    if entry.pod_identifier == pod_identifier
+                ]
+                for entry in victims:
+                    pod_cache.entries.remove(entry)
+                removed += len(victims)
+                now_empty = len(pod_cache.entries) == 0
+            if now_empty:
+                # An empty pod set would read as a broken prefix chain
+                # for EVERY pod (lookup early-stop); drop the key.
+                # Re-check under the resident cache first (same race
+                # narrowing as evict()): a concurrent add may have
+                # published a fresh claim since the lock was released.
+                current = self._data.get(request_key)
+                if current is not None and len(current) == 0:
+                    self._data.remove(request_key)
+        return removed

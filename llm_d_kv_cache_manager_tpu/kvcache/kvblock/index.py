@@ -87,6 +87,19 @@ class Index(ABC):
         evicted).
         """
 
+    @abstractmethod
+    def purge_pod(self, pod_identifier: str) -> int:
+        """Drop every entry for one pod; returns entries removed.
+
+        Administrative recovery operation (O(index size); not a hot
+        path): when a pod dies or its event stream gaps badly, its
+        stale entries keep attracting traffic until LRU churn clears
+        them — the reference simply lets them linger.  Keys whose pod
+        set empties are removed entirely so they cannot break other
+        pods' prefix chains at lookup.  Engine-key mappings may
+        linger, exactly as after an LRU eviction.
+        """
+
 
 @dataclass
 class InMemoryIndexConfig:

@@ -61,3 +61,9 @@ class InstrumentedIndex(Index):
 
     def get_request_key(self, engine_key: int) -> int:
         return self._inner.get_request_key(engine_key)
+
+    def purge_pod(self, pod_identifier: str) -> int:
+        removed = self._inner.purge_pod(pod_identifier)
+        if removed:
+            METRICS.index_evictions.inc(removed)
+        return removed

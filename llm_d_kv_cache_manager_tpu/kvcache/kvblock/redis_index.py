@@ -177,19 +177,25 @@ class RespClient:
     def execute(self, *command):
         return self.pipeline([command])[0]
 
-    def pipeline(self, commands: Iterable[Sequence]) -> List:
+    def pipeline(
+        self, commands: Iterable[Sequence], raise_on_error: bool = True
+    ) -> List:
         """Send all commands, read all replies; raise the first server error
         only after the stream is fully drained.  On transport errors the
-        connection is torn down and retried once on a fresh socket."""
+        connection is torn down and retried once on a fresh socket.
+        ``raise_on_error=False`` returns ``RespError`` instances in place
+        so callers can tolerate per-key failures (e.g. WRONGTYPE from
+        foreign keys in a shared database)."""
         commands = list(commands)
         if not commands:
             return []
         payload = b"".join(self._encode(c) for c in commands)
         with self._lock:
             replies = self._round_trip_locked(payload, len(commands))
-        for reply in replies:
-            if isinstance(reply, RespError):
-                raise reply
+        if raise_on_error:
+            for reply in replies:
+                if isinstance(reply, RespError):
+                    raise reply
         return replies
 
     def _round_trip_locked(self, payload: bytes, count: int) -> List:
@@ -410,3 +416,48 @@ class RedisIndex(Index):
         if raw is None:
             raise KeyError(f"engine key not found: {engine_key:#x}")
         return int(raw.decode())
+
+    def purge_pod(self, pod_identifier: str) -> int:
+        """SCAN-walk the request hashes, HDEL the pod's fields.
+
+        Cursor iteration keeps the server responsive (no KEYS); real
+        Redis auto-removes hashes whose last field is deleted, so
+        emptied keys cannot break other pods' prefix chains.  Shared
+        databases may hold foreign non-hash keys — their WRONGTYPE
+        replies are tolerated per key, never fatal to the purge.
+        """
+        prefix = f"{pod_identifier}@".encode()
+        removed = 0
+        cursor = b"0"
+        while True:
+            reply = self._client.execute(
+                "SCAN", cursor.decode(), "COUNT", "512"
+            )
+            cursor, keys = reply[0], reply[1]
+            hash_keys = [
+                key
+                for key in keys
+                if not key.startswith(_ENGINE_PREFIX.encode())
+            ]
+            if hash_keys:
+                field_lists = self._client.pipeline(
+                    [("HKEYS", key.decode()) for key in hash_keys],
+                    raise_on_error=False,
+                )
+                hdels = []
+                for key, fields in zip(hash_keys, field_lists):
+                    if isinstance(fields, RespError):
+                        continue  # foreign key of another type
+                    victims = [
+                        f for f in fields if f.startswith(prefix)
+                    ]
+                    if victims:
+                        removed += len(victims)
+                        hdels.append(
+                            ["HDEL", key.decode()]
+                            + [f.decode() for f in victims]
+                        )
+                if hdels:
+                    self._client.pipeline(hdels)
+            if cursor == b"0":
+                return removed

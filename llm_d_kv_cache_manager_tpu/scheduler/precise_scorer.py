@@ -17,6 +17,7 @@ A scheduler embeds this as a scorer plugin:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -102,6 +103,12 @@ class PrecisePrefixCacheScorerConfig:
     # Global-socket mode: one static endpoint carrying every pod's
     # events (kvcache_aware_scorer.go:141-147); None disables.
     zmq_endpoint: Optional[str] = None
+    # When a pod's subscription expires (TTL: it stopped being scored,
+    # i.e. the scheduler no longer sees it), also purge its index
+    # entries so stale claims stop attracting traffic.  Off by default:
+    # the reference lets entries linger and rebuild from live events,
+    # which is the right call for brief pod blips.
+    purge_index_on_expiry: bool = False
 
 
 # ------------------------------- the scorer -------------------------------
@@ -129,9 +136,7 @@ class PrecisePrefixCacheScorer:
         if self.config.discover_pods:
             self._subscriptions = TTLCache(
                 self.config.subscription_ttl_seconds,
-                on_evict=lambda pod, _: self.subscribers.remove_subscriber(
-                    pod
-                ),
+                on_evict=self._on_subscription_expired,
             )
             self._subscriptions.start_sweeper(
                 self.config.subscription_ttl_seconds
@@ -151,6 +156,34 @@ class PrecisePrefixCacheScorer:
         self.indexer.shutdown()
 
     # -- subscriber lifecycle --
+
+    def _on_subscription_expired(self, pod: str, address: str) -> None:
+        self.subscribers.remove_subscriber(pod)
+        if self.config.purge_index_on_expiry:
+            # Off-thread: the expiry callback runs under the TTL cache's
+            # callback lock, which every scoring cycle's subscription
+            # refresh also takes — an O(index) purge (network I/O on the
+            # Redis backend) inline here would stall the hot path.
+            threading.Thread(
+                target=self._purge_expired_pod,
+                args=(pod, address),
+                name=f"kvtpu-purge-{pod}",
+                daemon=True,
+            ).start()
+
+    def _purge_expired_pod(self, pod: str, address: str) -> None:
+        try:
+            removed = self.indexer.kv_block_index.purge_pod(address)
+            logger.info(
+                "purged %d index entries for expired pod %s (%s)",
+                removed,
+                pod,
+                address,
+            )
+        except Exception:  # noqa: BLE001 - purge failure must stay local
+            logger.exception(
+                "index purge for expired pod %s (%s) failed", pod, address
+            )
 
     def _refresh_subscriptions(self, pods: Sequence[Pod]) -> None:
         """Seen pods stay subscribed; unseen ones age out via TTL."""

@@ -66,7 +66,13 @@ class TTLCache(Generic[K, V]):
             return list(self._entries)
 
     def sweep(self) -> int:
-        """Evict every expired key now; returns the eviction count."""
+        """Evict every expired key now; returns the eviction count.
+
+        A raising ``on_evict`` must not abort the sweep (the remaining
+        expired keys were already removed from the map — skipping their
+        callbacks would leak whatever the callback tears down) nor kill
+        the background sweeper thread.
+        """
         now = time.monotonic()
         expired = []
         with self._lock:
@@ -75,7 +81,16 @@ class TTLCache(Generic[K, V]):
                     del self._entries[key]
                     expired.append((key, value))
         for key, value in expired:
-            self._fire_eviction(key, value)
+            try:
+                self._fire_eviction(key, value)
+            except Exception:  # noqa: BLE001 - callback bugs stay local
+                from llm_d_kv_cache_manager_tpu.utils.logging import (
+                    get_logger,
+                )
+
+                get_logger("utils.ttl_cache").exception(
+                    "on_evict callback failed for %r", key
+                )
         return len(expired)
 
     def _fire_eviction(self, key: K, value: V) -> None:

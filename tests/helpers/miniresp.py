@@ -139,6 +139,16 @@ class _Handler(socketserver.StreamRequestHandler):
                 return b":%d\r\n" % removed
             if cmd == b"HLEN":
                 return b":%d\r\n" % len(state.hashes.get(args[1], {}))
+            if cmd == b"SCAN":
+                # One-shot cursor: every key in a single page (real
+                # Redis pages; clients must loop until cursor "0"
+                # either way, which the index's purge_pod does).
+                keys = list(state.hashes) + list(state.strings)
+                out = b"*2\r\n" + _bulk(b"0")
+                out += b"*%d\r\n" % len(keys)
+                for key in keys:
+                    out += _bulk(key)
+                return out
             if cmd == b"EVAL":
                 return self._eval(state, args)
             if cmd == b"FLUSHALL":

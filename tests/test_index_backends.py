@@ -107,6 +107,40 @@ class TestIndexContract:
         with pytest.raises(ValueError):
             index.evict(1, [])
 
+    def test_purge_pod_removes_only_that_pod(self, index):
+        both = [POD1, POD2]
+        index.add([301, 302], [401, 402], both)
+        index.add([303], [403], [POD1])  # POD1-only key
+
+        removed = index.purge_pod(POD1.pod_identifier)
+        assert removed == 3
+
+        found = index.lookup([401, 402, 403])
+        # Shared keys keep POD2; the POD1-only key is gone entirely
+        # (an empty pod set would break every pod's prefix chain).
+        assert set(found) == {401, 402}
+        assert all(
+            p.pod_identifier == POD2.pod_identifier
+            for pods in found.values()
+            for p in pods
+        )
+        # Unknown pods purge nothing.
+        assert index.purge_pod("no-such-pod") == 0
+
+    def test_purge_pod_removes_every_tier(self, index):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+            PodEntry,
+        )
+
+        tiers = [
+            PodEntry(POD1.pod_identifier, "hbm"),
+            PodEntry(POD1.pod_identifier, "host"),
+            PodEntry(POD1.pod_identifier, "shared_storage"),
+        ]
+        index.add([311], [411], tiers)
+        assert index.purge_pod(POD1.pod_identifier) == 3
+        assert index.lookup([411]) == {}
+
     def test_readd_after_evict(self, index):
         index.add([150], [250], [POD1])
         index.evict(150, [POD1])

@@ -199,6 +199,53 @@ class TestSubscriberTTLLifecycle:
         assert removed == ["ns/pod-a"]
 
 
+class TestPurgeOnExpiry:
+    def test_expired_pod_purged_from_index(self, tmp_path):
+        """With purge_index_on_expiry, a pod whose subscription ages
+        out also loses its index entries (stale claims stop attracting
+        traffic); other pods' entries survive."""
+        tokenizer_dir = save_tokenizer_json(str(tmp_path), MODEL)
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=4),
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    workers=1, model_name=MODEL
+                ),
+            ),
+            tokenizer=LocalFastTokenizer(tokenizer_dir),
+        )
+        scorer = PrecisePrefixCacheScorer(
+            PrecisePrefixCacheScorerConfig(
+                indexer_config=IndexerConfig(),
+                subscription_ttl_seconds=0.1,
+                purge_index_on_expiry=True,
+            ),
+            indexer=indexer,
+        )
+        try:
+            from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+                PodEntry,
+            )
+
+            indexer.kv_block_index.add(
+                [0x51, 0x52],
+                [0x61, 0x62],
+                [PodEntry("10.0.0.1", "hbm"), PodEntry("10.0.0.2", "hbm")],
+            )
+            scorer._subscriptions.set("ns/pod-a", "10.0.0.1")
+            time.sleep(0.2)
+            scorer._subscriptions.sweep()
+            found = indexer.kv_block_index.lookup([0x61, 0x62])
+            survivors = {
+                p.pod_identifier
+                for pods in found.values()
+                for p in pods
+            }
+            assert survivors == {"10.0.0.2"}
+        finally:
+            scorer.shutdown()
+
+
 class TestDiscoveryTopicFilter:
     def test_discovered_subscriber_matches_engine_topics(self, tmp_path):
         """The plugin subscribes under the scheduler's namespaced pod
