@@ -78,8 +78,30 @@ def _make_handler(indexer: Indexer):
                 self._score_completions()
             elif self.path == "/score_chat_completions":
                 self._score_chat_completions()
+            elif self.path == "/admin/purge_pod":
+                self._purge_pod()
             else:
                 self._error(404, "not found")
+
+        def _purge_pod(self):
+            """Operator recovery: drop every index entry for one pod
+            (Index.purge_pod) — e.g. after a pod dies or its event
+            stream gapped badly.  Cluster-internal surface like the
+            rest of the service; O(index size), runs inline."""
+            request = self._read_json()
+            if request is None:
+                return
+            pod = request.get("pod", "")
+            if not pod:
+                self._error(400, "field 'pod' required")
+                return
+            try:
+                removed = indexer.kv_block_index.purge_pod(pod)
+            except Exception as exc:
+                logger.exception("purge_pod failed")
+                self._error(500, f"error: {exc}")
+                return
+            self._reply_json(200, {"pod": pod, "removed": removed})
 
         def _score_completions(self):
             request = self._read_json()

@@ -118,3 +118,21 @@ class TestHTTPService:
         with pytest.raises(urllib.error.HTTPError) as err:
             post(base, "/nope", {})
         assert err.value.code == 404
+
+    def test_admin_purge_pod(self, service):
+        indexer, base = service
+        seed(indexer, PROMPT, "pod-a")
+        seed(indexer, "pack my box with five dozen liquor jugs", "pod-b")
+        status, body = post(base, "/admin/purge_pod", {"pod": "pod-a"})
+        assert status == 200 and body["removed"] > 0
+        # pod-a no longer scores; pod-b untouched.
+        status, scores = post(
+            base, "/score_completions", {"prompt": PROMPT, "model": MODEL}
+        )
+        assert "pod-a" not in scores
+
+    def test_admin_purge_pod_requires_pod(self, service):
+        _, base = service
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(base, "/admin/purge_pod", {})
+        assert err.value.code == 400
