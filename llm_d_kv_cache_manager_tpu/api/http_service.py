@@ -31,7 +31,7 @@ from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 logger = get_logger("api.http_service")
 
 
-def _make_handler(indexer: Indexer):
+def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -56,10 +56,17 @@ def _make_handler(indexer: Indexer):
         def _read_json(self) -> Optional[dict]:
             try:
                 length = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(length))
+                obj = json.loads(self.rfile.read(length))
             except (ValueError, json.JSONDecodeError):
                 self._error(400, "invalid JSON body")
                 return None
+            if not isinstance(obj, dict):
+                # `null`/arrays/scalars are valid JSON: without this an
+                # object-assuming handler would send NO response (client
+                # hang) or crash the connection mid-request.
+                self._error(400, "JSON object body required")
+                return None
+            return obj
 
         def do_GET(self):
             if self.path == "/metrics":
@@ -83,11 +90,24 @@ def _make_handler(indexer: Indexer):
             else:
                 self._error(404, "not found")
 
+        def _admin_allowed(self) -> bool:
+            """Scoring is read-only; /admin/* mutates, so it gets its
+            own gate: a configured bearer token, or — when no token is
+            set — loopback clients only (kubectl port-forward / exec),
+            never the whole cluster network."""
+            if admin_token:
+                supplied = self.headers.get("Authorization", "")
+                return supplied == f"Bearer {admin_token}"
+            host = self.client_address[0]
+            return host == "::1" or host.startswith("127.")
+
         def _purge_pod(self):
             """Operator recovery: drop every index entry for one pod
             (Index.purge_pod) — e.g. after a pod dies or its event
-            stream gapped badly.  Cluster-internal surface like the
-            rest of the service; O(index size), runs inline."""
+            stream gapped badly.  O(index size), runs inline."""
+            if not self._admin_allowed():
+                self._error(403, "admin endpoint: token or loopback only")
+                return
             request = self._read_json()
             if request is None:
                 return
@@ -163,12 +183,17 @@ def _make_handler(indexer: Indexer):
 
 
 def serve(
-    indexer: Indexer, host: str = "0.0.0.0", port: int = 8080
+    indexer: Indexer,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    admin_token: Optional[str] = None,
 ) -> http.server.ThreadingHTTPServer:
     """Start the HTTP service on a background thread; returns the server
-    (call ``.shutdown()`` to stop)."""
+    (call ``.shutdown()`` to stop).  ``admin_token`` (env:
+    ``ADMIN_TOKEN``) gates ``/admin/*``; without one, admin calls are
+    accepted from loopback only."""
     server = http.server.ThreadingHTTPServer(
-        (host, port), _make_handler(indexer)
+        (host, port), _make_handler(indexer, admin_token=admin_token)
     )
     thread = threading.Thread(
         target=server.serve_forever, name="http-service", daemon=True
@@ -286,7 +311,11 @@ def main() -> None:  # pragma: no cover - CLI entry
     stop_beat = start_metrics_logging(
         float(os.environ.get("METRICS_LOGGING_INTERVAL", "60"))
     )
-    server = serve(indexer, port=int(os.environ.get("HTTP_PORT", "8080")))
+    server = serve(
+        indexer,
+        port=int(os.environ.get("HTTP_PORT", "8080")),
+        admin_token=os.environ.get("ADMIN_TOKEN"),
+    )
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

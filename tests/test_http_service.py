@@ -121,18 +121,81 @@ class TestHTTPService:
 
     def test_admin_purge_pod(self, service):
         indexer, base = service
+        other_prompt = "pack my box with five dozen liquor jugs"
         seed(indexer, PROMPT, "pod-a")
-        seed(indexer, "pack my box with five dozen liquor jugs", "pod-b")
+        seed(indexer, other_prompt, "pod-b")
         status, body = post(base, "/admin/purge_pod", {"pod": "pod-a"})
         assert status == 200 and body["removed"] > 0
-        # pod-a no longer scores; pod-b untouched.
         status, scores = post(
             base, "/score_completions", {"prompt": PROMPT, "model": MODEL}
         )
         assert "pod-a" not in scores
+        # Isolation: pod-b's entries survive the purge and still score.
+        status, scores = post(
+            base,
+            "/score_completions",
+            {"prompt": other_prompt, "model": MODEL},
+        )
+        assert scores.get("pod-b", 0) > 0
 
     def test_admin_purge_pod_requires_pod(self, service):
         _, base = service
         with pytest.raises(urllib.error.HTTPError) as err:
             post(base, "/admin/purge_pod", {})
         assert err.value.code == 400
+
+    def test_non_object_json_body_is_400(self, service):
+        """`null`/arrays are valid JSON; without the dict check the
+        handler would hang the keep-alive connection (no response) or
+        crash it mid-request."""
+        _, base = service
+        for body in (None, [1, 2], "x"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post(base, "/admin/purge_pod", body)
+            assert err.value.code == 400
+
+    def test_admin_token_gate(self, tmp_path):
+        """With ADMIN_TOKEN configured, /admin/* requires the bearer
+        token even from loopback; scoring stays open."""
+        from llm_d_kv_cache_manager_tpu.api.http_service import serve
+
+        tokenizer_dir = save_tokenizer_json(str(tmp_path), MODEL)
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=4),
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    workers=2, model_name=MODEL
+                ),
+            ),
+            tokenizer=LocalFastTokenizer(tokenizer_dir),
+        )
+        indexer.run()
+        server = serve(
+            indexer, host="127.0.0.1", port=0, admin_token="s3cret"
+        )
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post(base, "/admin/purge_pod", {"pod": "pod-a"})
+            assert err.value.code == 403
+            request = urllib.request.Request(
+                base + "/admin/purge_pod",
+                data=json.dumps({"pod": "pod-a"}).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "Authorization": "Bearer s3cret",
+                },
+                method="POST",
+            )
+            with urllib.request.urlopen(request, timeout=10) as resp:
+                assert resp.status == 200
+            # Scoring needs no token.
+            status, _ = post(
+                base,
+                "/score_completions",
+                {"prompt": PROMPT, "model": MODEL},
+            )
+            assert status == 200
+        finally:
+            server.shutdown()
+            indexer.shutdown()
