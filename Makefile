@@ -4,9 +4,27 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test unit-test e2e-test examples bench native native-race proto graft-check chart clean
+.PHONY: all lint test unit-test e2e-test examples bench native native-race proto graft-check chart clean
 
 all: native test
+
+# Same invocation as CI's lint step (.github/workflows/ci.yaml); the
+# flags also live in .flake8 so a bare `flake8` agrees.  clang-format
+# is advisory until the tree is normalized with a real binary.
+lint:
+	@if $(PYTHON) -c "import flake8" >/dev/null 2>&1; then \
+		$(PYTHON) -m flake8 llm_d_kv_cache_manager_tpu tests examples \
+			--max-line-length 100 --extend-ignore E203,W503; \
+	else \
+		echo "flake8 not installed; skipping python lint (CI runs it)"; \
+	fi
+	@if command -v clang-format >/dev/null 2>&1; then \
+		clang-format --dry-run --Werror \
+			llm_d_kv_cache_manager_tpu/native/src/*.cpp \
+			llm_d_kv_cache_manager_tpu/native/src/*.hpp; \
+	else \
+		echo "clang-format not installed; skipping native format check"; \
+	fi
 
 test: unit-test
 

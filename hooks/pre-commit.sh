@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Developer pre-commit gate (reference: hooks/pre-commit.sh — lint then
+# tests).  Install with:
+#   ln -s ../../hooks/pre-commit.sh .git/hooks/pre-commit
+set -e
+
+cd "$(git rev-parse --show-toplevel)"
+
+echo "-> lint"
+make lint
+
+echo "-> tests"
+make test
+
+echo "ok: all checks passed"

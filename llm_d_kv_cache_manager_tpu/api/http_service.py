@@ -30,6 +30,10 @@ from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("api.http_service")
 
+# Generous for scoring payloads (a 100k-token chat conversation is well
+# under 2 MiB of JSON) while bounding per-request buffering.
+MAX_BODY_BYTES = 16 * 1024 * 1024
+
 
 def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
     class Handler(http.server.BaseHTTPRequestHandler):
@@ -56,6 +60,25 @@ def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
         def _read_json(self) -> Optional[dict]:
             try:
                 length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                # Rejecting without consuming the body desyncs HTTP/1.1
+                # keep-alive (leftover bytes parse as the next request
+                # line); drop the connection instead.
+                self.close_connection = True
+                self._error(400, "invalid Content-Length")
+                return None
+            # A negative length would turn rfile.read into read-to-EOF —
+            # one crafted header wedges the handler thread until the
+            # client hangs up; an unbounded one buffers arbitrary bytes.
+            if length < 0:
+                self.close_connection = True
+                self._error(400, "invalid Content-Length")
+                return None
+            if length > MAX_BODY_BYTES:
+                self.close_connection = True
+                self._error(413, "request body too large")
+                return None
+            try:
                 obj = json.loads(self.rfile.read(length))
             except (ValueError, json.JSONDecodeError):
                 self._error(400, "invalid JSON body")

@@ -100,13 +100,24 @@ def decode_event_batch(payload: bytes) -> EventBatch:
         raise EventDecodeError(f"undecodable event batch: {exc}") from exc
     if not isinstance(raw, (list, tuple)) or len(raw) < 2:
         raise EventDecodeError(f"malformed event batch: {raw!r}")
-    ts = float(raw[0])
+    # Conversions guarded so type-confused payloads stay poison pills
+    # (EventDecodeError) instead of escaping as TypeError/ValueError and
+    # killing a pool worker.
+    try:
+        ts = float(raw[0])
+    except (TypeError, ValueError) as exc:
+        raise EventDecodeError(f"batch ts is not a number: {raw[0]!r}") from exc
     events = raw[1]
     if not isinstance(events, (list, tuple)):
         raise EventDecodeError("event batch events field is not an array")
     dp_rank = None
     if len(raw) >= 3 and raw[2] is not None:
-        dp_rank = int(raw[2])
+        try:
+            dp_rank = int(raw[2])
+        except (TypeError, ValueError, OverflowError) as exc:
+            raise EventDecodeError(
+                f"batch dp rank is not an int: {raw[2]!r}"
+            ) from exc
     return EventBatch(ts=ts, events=list(events), data_parallel_rank=dp_rank)
 
 
@@ -122,37 +133,54 @@ def decode_event(raw: Any) -> Event:
         raise EventDecodeError(f"malformed tagged union: {raw!r}")
     tag = raw[0]
     if isinstance(tag, bytes):
-        tag = tag.decode()
+        try:
+            tag = tag.decode()
+        except UnicodeDecodeError as exc:
+            raise EventDecodeError(f"non-UTF-8 event tag: {tag!r}") from exc
     fields = raw[1:]
 
-    if tag == BLOCK_STORED_TAG:
-        if len(fields) < 4:
-            raise EventDecodeError(
-                f"BlockStored requires 4 fields, got {len(fields)}"
+    try:
+        if tag == BLOCK_STORED_TAG:
+            if len(fields) < 4:
+                raise EventDecodeError(
+                    f"BlockStored requires 4 fields, got {len(fields)}"
+                )
+            medium = _optional(fields, 5)
+            lora_name = _optional(fields, 6)
+            return BlockStored(
+                block_hashes=list(fields[0]),
+                parent_block_hash=fields[1],
+                token_ids=[int(t) for t in (fields[2] or [])],
+                block_size=int(fields[3]),
+                lora_id=_optional(fields, 4),
+                medium=(
+                    medium.decode() if isinstance(medium, bytes) else medium
+                ),
+                lora_name=(
+                    lora_name.decode()
+                    if isinstance(lora_name, bytes)
+                    else lora_name
+                ),
             )
-        medium = _optional(fields, 5)
-        lora_name = _optional(fields, 6)
-        return BlockStored(
-            block_hashes=list(fields[0]),
-            parent_block_hash=fields[1],
-            token_ids=[int(t) for t in (fields[2] or [])],
-            block_size=int(fields[3]),
-            lora_id=_optional(fields, 4),
-            medium=medium.decode() if isinstance(medium, bytes) else medium,
-            lora_name=(
-                lora_name.decode()
-                if isinstance(lora_name, bytes)
-                else lora_name
-            ),
-        )
-    if tag == BLOCK_REMOVED_TAG:
-        if len(fields) < 1:
-            raise EventDecodeError("BlockRemoved requires a hash list")
-        medium = _optional(fields, 1)
-        return BlockRemoved(
-            block_hashes=list(fields[0]),
-            medium=medium.decode() if isinstance(medium, bytes) else medium,
-        )
+        if tag == BLOCK_REMOVED_TAG:
+            if len(fields) < 1:
+                raise EventDecodeError("BlockRemoved requires a hash list")
+            medium = _optional(fields, 1)
+            return BlockRemoved(
+                block_hashes=list(fields[0]),
+                medium=(
+                    medium.decode() if isinstance(medium, bytes) else medium
+                ),
+            )
+    except (TypeError, ValueError, OverflowError, UnicodeDecodeError) as exc:
+        # Field-level type confusion (an int where a list belongs, a
+        # dict token id, non-UTF-8 medium bytes, int(inf) overflow, ...)
+        # is a poison pill, not a worker-killing exception.
+        if isinstance(exc, EventDecodeError):
+            raise
+        raise EventDecodeError(
+            f"type-confused {tag} event: {exc}"
+        ) from exc
     if tag == ALL_BLOCKS_CLEARED_TAG:
         return AllBlocksCleared()
     raise EventDecodeError(f"unknown event tag: {tag!r}")

@@ -198,18 +198,9 @@ def _route(
 def _mesh_in_context() -> bool:
     """Whether with_sharding_constraint can resolve a PartitionSpec:
     either a ``with mesh:`` context or a ``jax.set_mesh`` mesh."""
-    try:
-        abstract = jax.sharding.get_abstract_mesh()
-        if abstract is not None and not getattr(abstract, "empty", True):
-            return True
-    except Exception:  # noqa: BLE001 - API drift across jax versions
-        pass
-    try:
-        from jax.interpreters import pxla
+    from llm_d_kv_cache_manager_tpu.parallel.mesh import mesh_is_active
 
-        return not pxla.thread_resources.env.physical_mesh.empty
-    except Exception:  # noqa: BLE001
-        return False
+    return mesh_is_active()
 
 
 def _constrain(x: jnp.ndarray, spec: P) -> jnp.ndarray:

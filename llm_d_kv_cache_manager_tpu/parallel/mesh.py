@@ -24,8 +24,9 @@ pods over the datacenter network while NCCL stays intra-pod
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Iterator, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -81,6 +82,58 @@ class MeshPlan:
                     f"mesh plan wants {fixed} devices, have {n_devices}"
                 )
         return sizes
+
+
+@contextmanager
+def activate(mesh: Mesh) -> Iterator[Mesh]:
+    """Enter a mesh context so bare PartitionSpecs resolve (e.g. in
+    ``lax.with_sharding_constraint``).
+
+    Prefers ``jax.set_mesh`` (jax >= 0.6, the non-deprecated path: it
+    also sets the abstract mesh, which ``with mesh:`` no longer does),
+    falling back to the legacy ``with mesh:`` thread-resources context
+    on older jax.  All framework entry points route through here so the
+    choice lives in one place.
+    """
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        with set_mesh(mesh):
+            yield mesh
+    else:
+        with mesh:
+            yield mesh
+
+
+def mesh_is_active() -> bool:
+    """Whether a PartitionSpec can currently resolve to mesh axes:
+    either a ``jax.set_mesh`` scope (abstract mesh) or a legacy
+    ``with mesh:`` context (thread-resources env).
+
+    Model code uses this to make sharding constraints a deterministic
+    no-op outside any mesh (single-device serving paths) instead of
+    try/except-ing ``with_sharding_constraint``, which would silently
+    bake a constraint-free trace into the jit cache under a mesh.
+    """
+    try:
+        abstract = jax.sharding.get_abstract_mesh()
+        if abstract is not None and not getattr(abstract, "empty", True):
+            return True
+    except Exception:  # noqa: BLE001 - API drift across jax versions
+        pass
+    try:
+        # ``with mesh:`` still routes through the legacy thread-resources
+        # env (jax 0.9: get_abstract_mesh()/get_mesh() only see
+        # jax.set_mesh).  The attribute works but warns; keep the probe
+        # quiet until the legacy context manager loses the env entirely.
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            from jax.interpreters import pxla
+
+            return not pxla.thread_resources.env.physical_mesh.empty
+    except Exception:  # noqa: BLE001
+        return False
 
 
 def make_mesh(

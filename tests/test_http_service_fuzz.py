@@ -1,0 +1,175 @@
+"""Seeded fuzz of the HTTP scoring service: the server must answer every
+request with a well-formed HTTP status (2xx-5xx) and keep serving —
+garbage bodies, type-confused fields, hostile Content-Length headers, and
+random paths must never wedge a handler thread or kill the listener.
+
+Complements test_http_service.py's example-based cases the same way
+test_kvevents_fuzz.py complements test_kvevents.py.
+"""
+
+import http.client
+import json
+import random
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.api.http_service import (
+    MAX_BODY_BYTES,
+    serve,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+    LocalFastTokenizer,
+)
+from tests.helpers.tiny_tokenizer import save_tokenizer_json
+
+MODEL = "test-model"
+PATHS = [
+    "/score_completions",
+    "/score_chat_completions",
+    "/admin/purge_pod",
+    "/metrics",
+    "/healthz",
+    "/nope",
+]
+
+
+@pytest.fixture()
+def service(tmp_path):
+    tokenizer_dir = save_tokenizer_json(str(tmp_path), MODEL)
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=4),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                workers=2, model_name=MODEL
+            ),
+        ),
+        tokenizer=LocalFastTokenizer(tokenizer_dir),
+    )
+    indexer.run()
+    server = serve(indexer, host="127.0.0.1", port=0)
+    yield server.server_address[1]
+    server.shutdown()
+    indexer.shutdown()
+
+
+def _request(port, method, path, body=b"", headers=None):
+    """One raw request; returns the status, or raises on a dropped
+    connection (the failure mode the fuzz exists to rule out)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        response = conn.getresponse()
+        response.read()
+        return response.status
+    finally:
+        conn.close()
+
+
+def _random_json(rng: random.Random, depth=0):
+    kinds = ["int", "str", "none", "float", "bool", "list", "dict"]
+    if depth >= 3:
+        kinds = kinds[:5]
+    kind = rng.choice(kinds)
+    if kind == "int":
+        return rng.randint(-(2**40), 2**40)
+    if kind == "str":
+        return rng.choice(["", "x", "prompt", "pods", "model", " "])
+    if kind == "none":
+        return None
+    if kind == "float":
+        return rng.random()
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "list":
+        return [_random_json(rng, depth + 1) for _ in range(rng.randint(0, 4))]
+    keys = ["prompt", "model", "pods", "messages", "tools", "pod", "x"]
+    return {
+        rng.choice(keys): _random_json(rng, depth + 1)
+        for _ in range(rng.randint(0, 5))
+    }
+
+
+class TestHTTPFuzz:
+    def test_random_bodies_always_answered(self, service):
+        port = service
+        rng = random.Random(0)
+        for _ in range(60):
+            path = rng.choice(PATHS)
+            if rng.random() < 0.5:
+                body = json.dumps(_random_json(rng)).encode()
+            else:
+                body = rng.randbytes(rng.randint(0, 64))
+            status = _request(
+                port,
+                rng.choice(["POST", "GET"]),
+                path,
+                body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            assert 200 <= status < 600
+
+    def test_hostile_content_length(self, service):
+        port = service
+        body = b'{"prompt": "x"}'
+        for bad in ["-1", "-99999", "notanint", str(MAX_BODY_BYTES + 1)]:
+            status = _request(
+                port,
+                "POST",
+                "/score_completions",
+                body=body,
+                headers={"Content-Length": bad},
+            )
+            assert status in (400, 413), f"Content-Length {bad}: {status}"
+
+    def test_rejected_body_does_not_desync_keepalive(self, service):
+        """An unread body on a keep-alive connection must not be parsed
+        as the next request line: the server closes the connection after
+        rejecting.  A follow-up on the same socket either fails (closed)
+        or — never — returns 501 for the garbage 'method'."""
+        port = service
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request(
+                "POST",
+                "/score_completions",
+                body=b"A" * 64,
+                headers={"Content-Length": str(MAX_BODY_BYTES + 1)},
+            )
+            response = conn.getresponse()
+            response.read()
+            assert response.status == 413
+            try:
+                conn.request("GET", "/healthz")
+                response = conn.getresponse()
+                response.read()
+                assert response.status == 200
+            except (http.client.HTTPException, ConnectionError, OSError):
+                pass  # server dropped the desynced connection: correct
+        finally:
+            conn.close()
+
+    def test_server_alive_after_fuzz(self, service):
+        port = service
+        rng = random.Random(1)
+        for _ in range(30):
+            _request(
+                port,
+                "POST",
+                rng.choice(PATHS),
+                body=rng.randbytes(rng.randint(0, 32)),
+            )
+        status = _request(
+            port,
+            "POST",
+            "/score_completions",
+            body=json.dumps({"prompt": "hello world", "model": MODEL}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 200

@@ -1,0 +1,227 @@
+"""Seeded structural fuzz of the KVEvents wire decoder + ingestion pool.
+
+The event stream arrives from the network (ZMQ pub/sub); any pod can send
+arbitrary bytes.  Two totality invariants, stronger than the example-based
+malformed-input tests in test_kvevents.py:
+
+1. The decoder is *total*: for any payload it either returns a batch or
+   raises ``EventDecodeError`` — never any other exception type (a raw
+   ``TypeError``/``IndexError`` escaping the codec would kill a pool
+   worker thread instead of being counted as a poison pill).
+2. The pool survives any storm: garbage payloads — random structures,
+   mutated valid batches, type-confused tagged unions — are dropped
+   per-event/per-message, and valid events delivered afterwards still
+   index correctly (reference behavior: poison pills dropped, never
+   retried, pool.go:206-215).
+
+All randomness is seeded: failures reproduce exactly.
+"""
+
+import random
+
+import msgpack
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    ChunkedTokenDatabase,
+    EMPTY_BLOCK_HASH,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import InMemoryIndexConfig
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockStored,
+    EventBatch,
+    EventDecodeError,
+    decode_event,
+    decode_event_batch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import Message, Pool, PoolConfig
+
+MODEL = "m"
+
+
+def _random_value(rng: random.Random, depth: int = 0):
+    """A random msgpack-encodable value, weighted toward the shapes the
+    codec actually inspects (lists with string heads)."""
+    kinds = ["int", "str", "bytes", "none", "float", "bool", "list", "dict"]
+    if depth >= 3:
+        kinds = kinds[:6]
+    kind = rng.choice(kinds)
+    if kind == "int":
+        return rng.randint(-(2**63), 2**64 - 1)
+    if kind == "str":
+        return rng.choice(
+            ["BlockStored", "BlockRemoved", "AllBlocksCleared", "x", ""]
+        )
+    if kind == "bytes":
+        return rng.randbytes(rng.randint(0, 12))
+    if kind == "none":
+        return None
+    if kind == "float":
+        # Non-finite values matter: int(float("inf")) raises
+        # OverflowError, a distinct escape path from TypeError/ValueError.
+        return rng.choice(
+            [rng.random() * 1e9, float("inf"), float("-inf"), float("nan")]
+        )
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "list":
+        return [
+            _random_value(rng, depth + 1) for _ in range(rng.randint(0, 5))
+        ]
+    return {
+        str(i): _random_value(rng, depth + 1)
+        for i in range(rng.randint(0, 3))
+    }
+
+
+def _assert_total(payload: bytes):
+    try:
+        decode_event_batch(payload)
+    except EventDecodeError:
+        pass  # the one sanctioned failure mode
+
+
+class TestDecoderTotality:
+    def test_random_structures(self):
+        rng = random.Random(0)
+        for _ in range(300):
+            _assert_total(msgpack.packb(_random_value(rng)))
+
+    def test_random_raw_bytes(self):
+        rng = random.Random(1)
+        for _ in range(300):
+            _assert_total(rng.randbytes(rng.randint(0, 64)))
+
+    def test_mutated_valid_batches(self):
+        """Bit flips / truncations / insertions of a real encoding."""
+        rng = random.Random(2)
+        valid = EventBatch(
+            ts=1.0,
+            events=[
+                BlockStored(
+                    block_hashes=[0xAB, 0xCD],
+                    parent_block_hash=None,
+                    token_ids=list(range(8)),
+                    block_size=4,
+                    medium="hbm",
+                )
+            ],
+            data_parallel_rank=1,
+        ).encode()
+        for _ in range(300):
+            buf = bytearray(valid)
+            for _ in range(rng.randint(1, 4)):
+                op = rng.choice(["flip", "trunc", "insert"])
+                if op == "flip" and buf:
+                    i = rng.randrange(len(buf))
+                    buf[i] ^= 1 << rng.randrange(8)
+                elif op == "trunc" and buf:
+                    del buf[rng.randrange(len(buf)):]
+                else:
+                    buf.insert(
+                        rng.randrange(len(buf) + 1), rng.randrange(256)
+                    )
+            _assert_total(bytes(buf))
+
+    def test_type_confused_tagged_unions(self):
+        """Well-formed batch framing around events whose fields have the
+        wrong types — the decoder may accept or reject, but only with
+        EventDecodeError."""
+        rng = random.Random(3)
+        for _ in range(300):
+            event = [rng.choice(
+                ["BlockStored", "BlockRemoved", "AllBlocksCleared"]
+            )] + [_random_value(rng) for _ in range(rng.randint(0, 8))]
+            _assert_total(
+                msgpack.packb([1.0, [event], rng.choice([None, 0, 1])])
+            )
+            try:
+                decode_event(event)
+            except EventDecodeError:
+                pass
+
+    def test_nonfinite_numeric_fields(self):
+        """int(float('inf')) raises OverflowError — a third escape path
+        beyond TypeError/ValueError; pin it explicitly."""
+        for bad in (float("inf"), float("-inf"), float("nan")):
+            _assert_total(msgpack.packb([1.0, [], bad]))  # dp_rank
+            _assert_total(
+                msgpack.packb(
+                    [1.0, [["BlockStored", [1], None, [1, 2], bad]], None]
+                )
+            )
+            try:
+                decode_event(["BlockStored", [1], None, [1, 2], bad])
+            except EventDecodeError:
+                pass
+
+    def test_random_tagged_unions(self):
+        """decode_event itself is total over arbitrary structures."""
+        rng = random.Random(5)
+        for _ in range(300):
+            try:
+                decode_event(_random_value(rng))
+            except EventDecodeError:
+                pass
+
+
+class TestPoolSurvivesStorm:
+    def test_garbage_storm_then_valid_events(self):
+        rng = random.Random(4)
+        index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = Pool(index, db, PoolConfig(concurrency=2))
+        pool.start()
+        try:
+            payloads = []
+            for _ in range(100):
+                payloads.append(msgpack.packb(_random_value(rng)))
+                payloads.append(rng.randbytes(rng.randint(0, 48)))
+                event = ["BlockStored"] + [
+                    _random_value(rng) for _ in range(rng.randint(0, 8))
+                ]
+                payloads.append(msgpack.packb([1.0, [event], None]))
+            for i, payload in enumerate(payloads):
+                pod = f"pod-{i % 4}"
+                pool.add_task(
+                    Message(
+                        topic=f"kv@{pod}@{MODEL}",
+                        payload=payload,
+                        pod_identifier=pod,
+                        model_name=MODEL,
+                    )
+                )
+            pool.drain()  # storm fully digested, no wedged worker
+
+            # Workers still index valid events after the storm.
+            tokens = [1, 2, 3, 4]
+            batch = EventBatch(
+                ts=2.0,
+                events=[
+                    BlockStored(
+                        block_hashes=[0x77],
+                        parent_block_hash=None,
+                        token_ids=tokens,
+                        block_size=4,
+                    )
+                ],
+            )
+            pool.add_task(
+                Message(
+                    topic="kv@pod-0@" + MODEL,
+                    payload=batch.encode(),
+                    pod_identifier="pod-0",
+                    model_name=MODEL,
+                )
+            )
+            pool.drain()
+            keys = db.tokens_to_kv_block_keys(
+                EMPTY_BLOCK_HASH, tokens, MODEL
+            )
+            hits = index.lookup(keys)
+            assert hits and "pod-0" in {
+                e.pod_identifier for pods in hits.values() for e in pods
+            }
+        finally:
+            pool.shutdown()

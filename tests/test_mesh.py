@@ -69,3 +69,46 @@ class TestHybridMesh:
         assert int(np.prod(list(mesh.shape.values()))) == len(
             jax.devices()
         )
+
+
+class TestMeshContext:
+    """activate() / mesh_is_active(): one place decides between
+    jax.set_mesh (modern) and the legacy ``with mesh:`` env."""
+
+    def test_inactive_outside_any_context(self):
+        from llm_d_kv_cache_manager_tpu.parallel.mesh import mesh_is_active
+
+        assert not mesh_is_active()
+
+    def test_activate_enters_and_exits(self):
+        from llm_d_kv_cache_manager_tpu.parallel.mesh import (
+            activate,
+            mesh_is_active,
+        )
+
+        mesh = make_mesh(MeshPlan(dp=4, tp=2), jax.devices()[:8])
+        with activate(mesh):
+            assert mesh_is_active()
+        assert not mesh_is_active()
+
+    def test_legacy_with_mesh_still_detected(self):
+        from llm_d_kv_cache_manager_tpu.parallel.mesh import mesh_is_active
+
+        mesh = make_mesh(MeshPlan(dp=4, tp=2), jax.devices()[:8])
+        with mesh:
+            assert mesh_is_active()
+        assert not mesh_is_active()
+
+    def test_sharding_constraint_resolves_under_activate(self):
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from llm_d_kv_cache_manager_tpu.parallel.mesh import activate
+
+        mesh = make_mesh(MeshPlan(dp=4, tp=2), jax.devices()[:8])
+        with activate(mesh):
+            y = jax.jit(
+                lambda v: lax.with_sharding_constraint(v, P("dp", "tp"))
+            )(jnp.ones((8, 2)))
+        assert float(y.sum()) == 16.0
