@@ -48,6 +48,13 @@ capacity regime):
    Pallas kernels vs their XLA counterparts at serving shapes, with a
    bench-time equality assert (the decode winner is routed into
    models/llama.py via LlamaConfig.decode_attention).
+
+Operational contract: one stderr progress line per phase (a timed-out
+run's tail shows where the time went), a persistent XLA compilation
+cache in ``.xla_cache/`` (compiles dominate a cold run on this 1-core
+host), and a soft wall-clock budget (``KVTPU_BENCH_BUDGET_S``, default
+2100 s) past which optional layers are truncated — flagged in the JSON
+— so the headline always prints inside the driver's timeout.
 """
 
 from __future__ import annotations
@@ -55,6 +62,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import sys
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -68,8 +76,45 @@ if os.environ.get("KVTPU_BENCH_PLATFORM"):
     jax.config.update(
         "jax_platforms", os.environ["KVTPU_BENCH_PLATFORM"]
     )
+# Persistent XLA compilation cache: the bench compiles ~10 programs
+# (two prefill shapes, decode, kernel sweep variants) at 20-60s each on
+# this 1-core host — the dominant fixed cost of a run.  Cached, a rerun
+# spends that budget measuring instead.
+_XLA_CACHE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".xla_cache"
+)
+try:  # cache knobs vary across jax versions; best-effort
+    jax.config.update("jax_compilation_cache_dir", _XLA_CACHE)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # noqa: BLE001
+    pass
 import jax.numpy as jnp
 import numpy as np
+
+_T_START = time.monotonic()
+# Soft wall-clock budget: the driver runs `python bench.py` under its
+# own (unknown) timeout; a bench that overruns records rc=124 and NO
+# metric.  Degrade instead: past the budget, optional layers are
+# truncated/skipped (marked in the JSON) and the headline still prints.
+_BUDGET_S = float(os.environ.get("KVTPU_BENCH_BUDGET_S", "2100"))
+
+
+def _elapsed() -> float:
+    return time.monotonic() - _T_START
+
+
+def _over_budget(reserve_s: float = 0.0) -> bool:
+    return _elapsed() + reserve_s > _BUDGET_S
+
+
+def _progress(phase: str) -> None:
+    """One stderr line per phase: a timed-out run's tail shows exactly
+    where the time went instead of a bare platform warning."""
+    print(
+        f"[bench +{_elapsed():7.1f}s] {phase}",
+        file=sys.stderr,
+        flush=True,
+    )
 
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
@@ -930,13 +975,23 @@ def run_matrix(
     t_hit: float,
     ideal_service: float,
     warmup: set,
-) -> List[dict]:
+) -> Tuple[List[dict], bool]:
     """detail.matrix: strategies x QPS ladder x arrival seeds on the
-    virtual clock, plus a pool-churn regime at the headline QPS."""
+    virtual clock, plus a pool-churn regime at the headline QPS.
+
+    Returns (cells, truncated): past the soft budget the remaining
+    cells are dropped and flagged rather than overrunning the driver's
+    timeout with the headline unreported."""
     cells: List[dict] = []
+
+    def _out_of_time() -> bool:
+        return _over_budget(reserve_s=30.0)
+
     for frac in QPS_FRACTIONS:
         qps = frac * NUM_PODS / ideal_service
         for strategy in STRATEGIES:
+            if _out_of_time():
+                return cells, True
             cells.append(
                 _matrix_cell(
                     strategy, frac, qps, requests, hashes_list,
@@ -951,6 +1006,8 @@ def run_matrix(
     # precise-vs-estimated gap, benchmarking/73-capacity).
     qps = 0.7 * NUM_PODS / ideal_service
     for strategy in STRATEGIES:
+        if _out_of_time():
+            return cells, True
         cells.append(
             _matrix_cell(
                 strategy, 0.7, qps, requests, hashes_list,
@@ -967,6 +1024,8 @@ def run_matrix(
     # Only the history-bearing strategies: for load/random/rr the
     # reset is a no-op and the cells would duplicate the steady rows.
     for strategy in ("precise", "estimated"):
+        if _out_of_time():
+            return cells, True
         cells.append(
             _matrix_cell(
                 strategy, 0.7, qps, requests, hashes_list,
@@ -975,7 +1034,7 @@ def run_matrix(
                 reset_history_at=len(requests) // 2,
             )
         )
-    return cells
+    return cells, False
 
 
 DEVICE_INIT_TIMEOUT_S = 900.0
@@ -1033,6 +1092,7 @@ def main() -> None:
         )
         return
 
+    _progress(f"device ready ({jax.devices()[0].platform}); init params")
     rng = random.Random(0)
     requests = make_prompts(rng)
     params = llama.init_params(jax.random.PRNGKey(0), CFG)
@@ -1052,6 +1112,7 @@ def main() -> None:
     )
     # Warm both shapes so compile time stays out of the TTFT samples,
     # and measure per-path service times to place the arrival rate.
+    _progress("compile + warm prefill shapes")
     warm = SimPod("warm", params)
     full_ids, _ = warm.alloc(TOTAL_TOKENS // BLOCK_SIZE)
     tok = jnp.zeros((1, TOTAL_TOKENS), jnp.int32)
@@ -1079,6 +1140,7 @@ def main() -> None:
 
     # detail.kernels: compiled Pallas-vs-XLA at serving shapes, and the
     # decode winner routed into the headline via decode_attention.
+    _progress("detail.kernels: Pallas-vs-XLA sweep")
     kernels = bench_kernels(readback_rtt)
     decode_winner = kernels.get("paged_decode", {}).get("winner")
     if decode_winner:
@@ -1090,25 +1152,31 @@ def main() -> None:
     # Secondary metric: decode throughput over the warm pod's full
     # 8448-token context (the reference's output-tok/s axis; decode
     # attention is whichever kernel detail.kernels just measured ahead).
-    decode = jax.jit(
-        lambda p, t, kv, bt, cl: llama.decode_step(p, t, kv, bt, cl, CFG),
-        donate_argnums=(2,),
-    )
-    table = jnp.asarray([full_ids], jnp.int32)
-    ctx = jnp.asarray([TOTAL_TOKENS], jnp.int32)
-    step_tok = jnp.zeros((1,), jnp.int32)
-    logits, warm.kv = decode(params, step_tok, warm.kv, table, ctx)
-    int(jnp.argmax(logits[0]))  # compile + drain
-    decode_steps = 16
-    t0 = time.perf_counter()
-    for _ in range(decode_steps):
+    decode_tok_s = None
+    if not _over_budget(reserve_s=120.0):
+        _progress("decode throughput")
+        decode = jax.jit(
+            lambda p, t, kv, bt, cl: llama.decode_step(
+                p, t, kv, bt, cl, CFG
+            ),
+            donate_argnums=(2,),
+        )
+        table = jnp.asarray([full_ids], jnp.int32)
+        ctx = jnp.asarray([TOTAL_TOKENS], jnp.int32)
+        step_tok = jnp.zeros((1,), jnp.int32)
         logits, warm.kv = decode(params, step_tok, warm.kv, table, ctx)
-    int(jnp.argmax(logits[0]))
-    decode_elapsed = max(
-        time.perf_counter() - t0 - readback_rtt, 1e-4
-    )
-    decode_tok_s = decode_steps / decode_elapsed
-    del warm, logits
+        int(jnp.argmax(logits[0]))  # compile + drain
+        decode_steps = 16
+        t0 = time.perf_counter()
+        for _ in range(decode_steps):
+            logits, warm.kv = decode(params, step_tok, warm.kv, table, ctx)
+        int(jnp.argmax(logits[0]))
+        decode_elapsed = max(
+            time.perf_counter() - t0 - readback_rtt, 1e-4
+        )
+        decode_tok_s = round(decode_steps / decode_elapsed, 1)
+        del logits
+    del warm
 
     # detail.mfu: full-prefill throughput vs chip peak.
     mfu = bench_mfu(t_miss)
@@ -1130,7 +1198,17 @@ def main() -> None:
     # seeds — one Poisson draw has ~±10-20% noise (burned r2->r3), so
     # the reported value is the median seed and the spread is explicit.
     per_seed: List[dict] = []
+    headline_truncated = False
     for seed in ARRIVAL_SEEDS:
+        if per_seed and _over_budget(reserve_s=180.0):
+            # ~1 headline seed costs 2 fleet runs of real prefills;
+            # report the seeds measured rather than record nothing.
+            headline_truncated = True
+            _progress(
+                f"budget: stopping headline after {len(per_seed)} seed(s)"
+            )
+            break
+        _progress(f"headline seed {seed}: real-compute fleet runs")
         arrivals = poisson_arrivals(qps, len(requests), seed)
         rr_ttfts, rr_hit = run_fleet(
             "round_robin", requests, params, prefill_full,
@@ -1165,10 +1243,12 @@ def main() -> None:
     speedup = median["speedup"]
 
     # detail.matrix: 5 strategies x QPS ladder x seeds, virtual clock.
+    _progress("detail.matrix: virtual-clock strategy ladder")
     hashes_list = [block_hash_chain(tokens) for _, _, tokens in requests]
-    matrix = run_matrix(
+    matrix, matrix_truncated = run_matrix(
         requests, hashes_list, t_miss, t_hit, ideal_service, warmup_idx
     )
+    _progress("emit")
 
     print(
         json.dumps(
@@ -1198,10 +1278,14 @@ def main() -> None:
                     "service_miss_s": round(t_miss, 4),
                     "service_hit_s": round(t_hit, 4),
                     "readback_rtt_s": round(readback_rtt, 4),
-                    "decode_tok_s_per_seq": round(decode_tok_s, 1),
+                    "decode_tok_s_per_seq": decode_tok_s,
                     "decode_attention": CFG.decode_attention,
                     "device": jax.devices()[0].platform,
                     "requests": len(requests),
+                    "elapsed_s": round(_elapsed(), 1),
+                    "budget_s": _BUDGET_S,
+                    "headline_seeds_truncated": headline_truncated,
+                    "matrix_truncated": matrix_truncated,
                     "matrix": matrix,
                     "mfu": mfu,
                     "kernels": kernels,

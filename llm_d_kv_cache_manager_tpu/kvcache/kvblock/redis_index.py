@@ -146,33 +146,77 @@ class RespClient:
             parts.append(b"$%d\r\n%s\r\n" % (len(arg), arg))
         return b"".join(parts)
 
-    def _read_reply(self):
+    # Caps on attacker/misconfiguration-controlled sizes (e.g. the URL
+    # points at an HTTP port, or a proxy garbles the stream): redis's own
+    # proto-max-bulk-len default, and an array bound far above any reply
+    # the index issues.
+    _MAX_BULK = 512 * 1024 * 1024
+    _MAX_ARRAY = 1 << 22
+    _MAX_DEPTH = 32
+    # Type-line bound: real RESP lines are tiny (a type byte + an
+    # integer or a short status).  Without a limit, readline() on a
+    # newline-free hostile stream buffers it whole before any other cap
+    # is consulted.
+    _MAX_LINE = 64 * 1024
+
+    def _read_reply(self, depth: int = 0):
         """Read one reply; server error replies are *returned* as RespError
-        instances (not raised) so a pipeline never desyncs the stream."""
-        line = self._reader.readline()
+        instances (not raised) so a pipeline never desyncs the stream.
+
+        Any malformed frame raises ConnectionError — not ValueError /
+        UnicodeDecodeError / RecursionError — because a garbled stream
+        means the connection is unusable: _round_trip_locked must tear
+        it down and reconnect rather than keep pipelining on a desynced
+        socket."""
+        if depth > self._MAX_DEPTH:
+            raise ConnectionError("RESP reply nested too deeply")
+        line = self._reader.readline(self._MAX_LINE)
         if not line:
             raise ConnectionError("connection closed by server")
+        if not line.endswith(b"\r\n"):
+            # Truncated stream, or a line at the limit with no newline.
+            raise ConnectionError(f"malformed RESP line: {line[:64]!r}")
         kind, payload = line[:1], line[1:-2]
         if kind == b"+":
-            return payload.decode()
+            return payload.decode("utf-8", "replace")
         if kind == b"-":
-            return RespError(payload.decode())
+            return RespError(payload.decode("utf-8", "replace"))
         if kind == b":":
-            return int(payload)
+            return self._parse_int(payload)
         if kind == b"$":
-            length = int(payload)
+            length = self._parse_int(payload)
             if length == -1:
                 return None
+            if not 0 <= length <= self._MAX_BULK:
+                raise ConnectionError(f"bad RESP bulk length {length}")
             data = self._reader.read(length + 2)
             if len(data) != length + 2:
                 raise ConnectionError("short read from server")
+            if data[-2:] != b"\r\n":
+                # Wrong-length garbled frame: without this check the
+                # stripped payload would be returned as a *successful*
+                # reply and the stream left desynced.
+                raise ConnectionError("bulk reply missing terminator")
             return data[:-2]
         if kind == b"*":
-            count = int(payload)
+            count = self._parse_int(payload)
             if count == -1:
                 return None
-            return [self._read_reply() for _ in range(count)]
+            if not 0 <= count <= self._MAX_ARRAY:
+                raise ConnectionError(f"bad RESP array length {count}")
+            return [self._read_reply(depth + 1) for _ in range(count)]
         raise ConnectionError(f"unknown RESP type: {kind!r}")
+
+    @staticmethod
+    def _parse_int(payload: bytes) -> int:
+        # RESP grammar, not Python's int() (which accepts underscores,
+        # whitespace, and '+': a corrupted b"1_0" must not parse as 10).
+        digits = payload[1:] if payload[:1] == b"-" else payload
+        if not digits or not digits.isdigit():
+            raise ConnectionError(
+                f"malformed RESP integer: {payload[:64]!r}"
+            )
+        return int(payload)
 
     def execute(self, *command):
         return self.pipeline([command])[0]

@@ -245,3 +245,45 @@ class TestUdsInIndexerConfig:
         names = indexer.tokenization_pool._tokenizer.type()
         assert "uds" in names
         indexer.shutdown()
+
+
+class TestWireRobustness:
+    """Garbage bytes on the wire method path must yield an error status
+    (grpc deserialization failure), never kill the server."""
+
+    def test_garbage_request_bytes_then_valid_call(self, scoring_endpoint):
+        import random
+
+        import grpc
+
+        from llm_d_kv_cache_manager_tpu.api.grpc_services import (
+            INDEXER_SERVICE,
+        )
+
+        indexer, endpoint = scoring_endpoint
+        seed_index(indexer, PROMPT, "pod-a")
+        rng = random.Random(0)
+        channel = grpc.insecure_channel(endpoint)
+        raw = channel.unary_unary(
+            f"/{INDEXER_SERVICE}/GetPodScores",
+            request_serializer=lambda b: b,  # send bytes verbatim
+            response_deserializer=lambda b: b,
+        )
+        for _ in range(20):
+            with pytest.raises(grpc.RpcError) as err:
+                raw(rng.randbytes(rng.randint(1, 64)), timeout=10)
+            assert err.value.code() in (
+                grpc.StatusCode.INTERNAL,
+                grpc.StatusCode.INVALID_ARGUMENT,
+                grpc.StatusCode.UNKNOWN,
+            )
+        channel.close()
+
+        client = new_client(endpoint)
+        response = client.GetPodScores(
+            indexer_pb2.GetPodScoresRequest(
+                prompt=PROMPT, model_name=MODEL, pod_identifiers=["pod-a"]
+            )
+        )
+        scores = {s.pod: s.score for s in response.scores}
+        assert scores["pod-a"] > 0

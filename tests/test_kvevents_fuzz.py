@@ -166,6 +166,31 @@ class TestDecoderTotality:
                 pass
 
 
+class TestEngineHashTotality:
+    def test_normalizer_raises_only_type_value_errors(self):
+        """pool.digest treats TypeError/ValueError from the hash
+        normalizer as per-event poison; nothing else may escape."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+            engine_hash_to_uint64,
+        )
+
+        rng = random.Random(6)
+        cases = [_random_value(rng) for _ in range(300)] + [
+            True,
+            b"",
+            float("inf"),
+            float("nan"),
+            2**200,
+            -(2**200),
+        ]
+        for raw in cases:
+            try:
+                value = engine_hash_to_uint64(raw)
+            except (TypeError, ValueError):
+                continue
+            assert 0 <= value < 2**64
+
+
 class TestPoolSurvivesStorm:
     def test_garbage_storm_then_valid_events(self):
         rng = random.Random(4)

@@ -1,0 +1,194 @@
+"""Seeded fuzz of the RESP2 client against a hostile/garbled server.
+
+The index may be pointed at the wrong port (an HTTP server), sit behind
+a garbling proxy, or face a malicious peer.  Totality invariant: the
+client surfaces only ``ConnectionError`` (transport/framing, after
+tearing the socket down) or ``RespError`` (server-reported) — never
+ValueError / UnicodeDecodeError / RecursionError / MemoryError from the
+frame parser — and recovers on the next call once the stream is sane.
+"""
+
+import random
+import socket
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+    RedisEndpoint,
+    RespClient,
+    RespError,
+)
+
+GARBAGE_FRAMES = [
+    b":\r\n",
+    b":abc\r\n",
+    b":9" * 40 + b"\r\n",
+    b"$abc\r\n",
+    b"$-5\r\nxx\r\n",
+    b"$999999999999999\r\n",
+    b"*xyz\r\n",
+    b"*-7\r\n",
+    b"*99999999999\r\n",
+    b"*1\r\n" * 64 + b":1\r\n",  # deep nesting
+    b"-\xff\xfe error\r\n",  # non-UTF-8 error line
+    b"+\xc0\x80\r\n",  # non-UTF-8 simple string
+    b"?what\r\n",
+    b"HTTP/1.1 200 OK\r\n",
+    b"\x00\x01\x02\r\n",
+    b"+OK",  # missing terminator then close
+    b"$1_0\r\n" + b"x" * 12,  # int() underscore liberalism
+    b"$ 3\r\nabc\r\n",  # int() whitespace liberalism
+    b"$+3\r\nabc\r\n",  # int() leading-plus liberalism
+    b"$3\r\nabcde\r\n",  # wrong length: terminator check must fire
+    b"+" + b"y" * (256 * 1024),  # newline-free flood: line cap must fire
+]
+
+
+class HostileServer:
+    """Accepts connections; replies to each command with the configured
+    payload (or a seeded garbage frame), then keeps the socket open so
+    the client sees a garbled stream rather than a clean close."""
+
+    def __init__(self):
+        self._sock = socket.create_server(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self.mode = "garbage"  # or "ok"
+        self._rng = random.Random(0)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        self._sock.settimeout(0.2)
+        conns = []
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            conn.settimeout(0.2)
+            conns.append(conn)
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._sock.close()
+
+    def _handle(self, conn):
+        buffer = b""
+        while not self._stop.is_set():
+            try:
+                chunk = conn.recv(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not chunk:
+                return
+            buffer += chunk
+            # One reply per complete inline command array received; a
+            # RESP command is "*N\r\n" + 2N lines.
+            while True:
+                reply = self._one_command_consumed(buffer)
+                if reply is None:
+                    break
+                buffer = reply
+                try:
+                    if self.mode == "ok":
+                        conn.sendall(b"+OK\r\n")
+                    elif self.mode == "wrong_length":
+                        conn.sendall(b"$3\r\nabcde\r\n")
+                    else:
+                        conn.sendall(self._rng.choice(GARBAGE_FRAMES))
+                except OSError:
+                    return
+
+    @staticmethod
+    def _one_command_consumed(buffer):
+        if not buffer.startswith(b"*"):
+            return b"" if buffer else None
+        head, sep, rest = buffer.partition(b"\r\n")
+        if not sep:
+            return None
+        try:
+            n = int(head[1:])
+        except ValueError:
+            return b""
+        for _ in range(2 * n):
+            _, sep, rest = rest.partition(b"\r\n")
+            if not sep:
+                return None
+        return rest
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+@pytest.fixture()
+def hostile():
+    server = HostileServer()
+    yield server
+    server.close()
+
+
+def make_client(port):
+    return RespClient(
+        endpoint=RedisEndpoint(host="127.0.0.1", port=port), timeout=2.0
+    )
+
+
+class TestRespFuzz:
+    def test_garbage_replies_surface_as_connection_errors(self, hostile):
+        client = make_client(hostile.port)
+        for _ in range(40):
+            try:
+                client.execute("PING")
+            except (ConnectionError, RespError):
+                pass  # the two sanctioned failure modes
+            except OSError:
+                pass  # timeouts on withheld bytes are transport errors too
+        client.close()
+
+    def test_wrong_length_bulk_never_returns_data(self, hostile):
+        """'$3\\r\\nabcde\\r\\n' must not come back as b'abc' — a garbled
+        frame is a connection error, not a successful reply."""
+        hostile.mode = "wrong_length"
+        client = make_client(hostile.port)
+        for _ in range(5):
+            try:
+                reply = client.execute("GET", "k")
+            except (ConnectionError, RespError, OSError):
+                continue
+            raise AssertionError(
+                f"garbled bulk returned as valid reply: {reply!r}"
+            )
+        client.close()
+
+    def test_liberal_int_forms_rejected(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+            RespClient,
+        )
+
+        for bad in (b"1_0", b" 3", b"+3", b"", b"-", b"3a", b"0x10"):
+            with pytest.raises(ConnectionError):
+                RespClient._parse_int(bad)
+        assert RespClient._parse_int(b"-1") == -1
+        assert RespClient._parse_int(b"42") == 42
+
+    def test_client_recovers_when_stream_heals(self, hostile):
+        client = make_client(hostile.port)
+        for _ in range(10):
+            try:
+                client.execute("PING")
+            except (ConnectionError, RespError, OSError):
+                pass
+        hostile.mode = "ok"
+        # The garbled socket was torn down; a fresh call reconnects.
+        assert client.execute("PING") == "OK"
+        client.close()
