@@ -92,11 +92,30 @@ import jax.numpy as jnp
 import numpy as np
 
 _T_START = time.monotonic()
+
+
+def _env_float(name: str, default: float) -> float:
+    """A malformed knob must not crash before main()'s parseable-error
+    machinery exists; fall back to the default, loudly."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        print(
+            f"[bench] ignoring malformed {name}={raw!r}; "
+            f"using {default}",
+            file=sys.stderr,
+        )
+        return default
+
+
 # Soft wall-clock budget: the driver runs `python bench.py` under its
 # own (unknown) timeout; a bench that overruns records rc=124 and NO
 # metric.  Degrade instead: past the budget, optional layers are
 # truncated/skipped (marked in the JSON) and the headline still prints.
-_BUDGET_S = float(os.environ.get("KVTPU_BENCH_BUDGET_S", "2100"))
+_BUDGET_S = _env_float("KVTPU_BENCH_BUDGET_S", 2100.0)
 
 
 def _elapsed() -> float:
@@ -798,6 +817,34 @@ def bench_kernels(readback_rtt: float) -> dict:
         sweep[f"P{blocks_per_step}_us"] = round(t * 1e6, 1)
         if t < t_decode_pallas:
             best_p, t_decode_pallas, decode_err = blocks_per_step, t, err
+    # bf16-operand (mxu_native) dot variant at the winning tile: skips
+    # the f32 upcast of K/V in VMEM.  Purely an optional speed variant:
+    # failing the equality gate makes it INELIGIBLE (noted in the
+    # sweep), never a bench abort — unlike the P-sweep asserts above,
+    # which gate the default kernel's correctness.
+    mxu_native = False
+    err = max_rel_err(
+        paged_decode_attention_pallas(
+            q, kv_layer, table, ctx,
+            blocks_per_step=best_p, mxu_native=True,
+        ),
+        xla_out,
+    )
+    if err < 0.05:
+        t = time_chained(
+            lambda qq: paged_decode_attention_pallas(
+                qq, kv_layer, table, ctx,
+                blocks_per_step=best_p, mxu_native=True,
+            ),
+            q,
+            readback_rtt,
+            steps=96,
+        )
+        sweep[f"P{best_p}_bf16_us"] = round(t * 1e6, 1)
+        if t < t_decode_pallas:
+            mxu_native, t_decode_pallas, decode_err = True, t, err
+    else:
+        sweep[f"P{best_p}_bf16_us"] = f"ineligible: rel err {err:.4f}"
     t_decode_xla = time_chained(
         lambda qq: paged_attention(qq, kv_layer, table, ctx),
         q,
@@ -838,6 +885,7 @@ def bench_kernels(readback_rtt: float) -> dict:
             "winner": decode_winner,
             "blocks_per_step_sweep": sweep,
             "blocks_per_step": best_p,
+            "mxu_native": mxu_native,
         },
         "flash_prefill": {
             "shape": f"B=1 T={Tq} H={H} D={Dh}",
@@ -1037,7 +1085,7 @@ def run_matrix(
     return cells, False
 
 
-DEVICE_INIT_TIMEOUT_S = 900.0
+DEVICE_INIT_TIMEOUT_S = _env_float("KVTPU_BENCH_DEVICE_TIMEOUT_S", 900.0)
 
 
 def require_device() -> Optional[str]:
@@ -1148,12 +1196,15 @@ def main() -> None:
         CFG.decode_blocks_per_step = kernels["paged_decode"][
             "blocks_per_step"
         ]
+        CFG.decode_mxu_native = kernels["paged_decode"]["mxu_native"]
 
     # Secondary metric: decode throughput over the warm pod's full
     # 8448-token context (the reference's output-tok/s axis; decode
     # attention is whichever kernel detail.kernels just measured ahead).
     decode_tok_s = None
+    decode_truncated = True
     if not _over_budget(reserve_s=120.0):
+        decode_truncated = False
         _progress("decode throughput")
         decode = jax.jit(
             lambda p, t, kv, bt, cl: llama.decode_step(
@@ -1285,6 +1336,7 @@ def main() -> None:
                     "elapsed_s": round(_elapsed(), 1),
                     "budget_s": _BUDGET_S,
                     "headline_seeds_truncated": headline_truncated,
+                    "decode_truncated": decode_truncated,
                     "matrix_truncated": matrix_truncated,
                     "matrix": matrix,
                     "mfu": mfu,

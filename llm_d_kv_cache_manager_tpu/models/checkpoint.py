@@ -43,12 +43,25 @@ def restore_checkpoint(path: str, abstract_target: Optional[Any] = None):
     onto its target device layout — the multi-chip resume path.  With
     None, arrays land as numpy on host.
     """
+    import warnings
+
     checkpointer = _checkpointer()
-    if abstract_target is not None:
-        return checkpointer.restore(
-            os.path.abspath(path), target=abstract_target
+    with warnings.catch_warnings():
+        # Restoring without explicit shardings (host restore, or an
+        # abstract target built for structure only) makes orbax read the
+        # layouts from the checkpoint's own sharding file and warn about
+        # it.  That is this function's documented contract, not a
+        # misuse; keep the warning out of every caller's output.
+        warnings.filterwarnings(
+            "ignore",
+            message="Sharding info not provided when restoring",
+            category=UserWarning,
         )
-    return checkpointer.restore(os.path.abspath(path))
+        if abstract_target is not None:
+            return checkpointer.restore(
+                os.path.abspath(path), target=abstract_target
+            )
+        return checkpointer.restore(os.path.abspath(path))
 
 
 def abstract_like(state: Any, shardings: Optional[Any] = None):

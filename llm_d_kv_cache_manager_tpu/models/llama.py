@@ -66,6 +66,10 @@ class LlamaConfig:
     # bench.py detail.kernels sweeps this at serving shapes and routes
     # the measured winner here.
     decode_blocks_per_step: int = 4
+    # Feed the decode-attention dots bf16 operands (f32 accumulation)
+    # instead of upcasting K/V in VMEM; swept by bench.py alongside the
+    # tile size.
+    decode_mxu_native: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -393,6 +397,7 @@ def decode_step(
                 block_table,
                 context_len,
                 blocks_per_step=cfg.decode_blocks_per_step,
+                mxu_native=cfg.decode_mxu_native,
             )
         else:
             attn = paged_attention(

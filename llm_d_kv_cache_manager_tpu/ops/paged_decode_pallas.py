@@ -46,6 +46,7 @@ def _decode_kernel(
     groups: int,
     scale: float,
     blocks_per_step: int,
+    mxu_native: bool,
 ):
     kv_refs = rest[:blocks_per_step]
     out_ref = rest[blocks_per_step]
@@ -65,8 +66,13 @@ def _decode_kernel(
     H = q_ref.shape[1]
     D = q_ref.shape[2]
     Hkv = kv_refs[0].shape[3]
+    # mxu_native: feed the dots bf16 operands with f32 accumulation (the
+    # MXU's native mode) instead of upcasting K/V after the DMA — saves
+    # the VPU cast and halves the operands' VMEM footprint.  Softmax
+    # statistics and accumulators stay f32 either way.
+    compute_dtype = q_ref.dtype if mxu_native else jnp.float32
     q = q_ref[0].astype(jnp.float32) * scale  # [H, D]
-    qb = q.reshape(Hkv, groups, D)
+    qb = q.reshape(Hkv, groups, D).astype(compute_dtype)
 
     for i, kv_ref in enumerate(kv_refs):
         # Valid positions in sub-block i: [(j*P+i)*bs, ctx).
@@ -74,8 +80,8 @@ def _decode_kernel(
 
         @pl.when(valid > 0)
         def _attend(kv_ref=kv_ref, valid=valid):
-            k = kv_ref[0, 0].astype(jnp.float32)  # [bs, Hkv, D]
-            v = kv_ref[0, 1].astype(jnp.float32)
+            k = kv_ref[0, 0].astype(compute_dtype)  # [bs, Hkv, D]
+            v = kv_ref[0, 1].astype(compute_dtype)
             kb = k.transpose(1, 0, 2)  # [Hkv, bs, D]
             vb = v.transpose(1, 0, 2)
             s = jax.lax.dot_general(
@@ -94,13 +100,13 @@ def _decode_kernel(
             m_new = jnp.maximum(
                 m_prev, jnp.max(s, axis=1, keepdims=True)
             )
-            p = jnp.exp(s - m_new)  # [H, bs]
+            p = jnp.exp(s - m_new)  # [H, bs] f32
             correction = jnp.exp(m_prev - m_new)
             l_ref[...] = l_ref[...] * correction + jnp.sum(
                 p, axis=1, keepdims=True
             )
             m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-            pb = p.reshape(Hkv, groups, block_size)
+            pb = p.reshape(Hkv, groups, block_size).astype(compute_dtype)
             o = jax.lax.dot_general(
                 pb,
                 vb,
@@ -117,7 +123,8 @@ def _decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret", "blocks_per_step")
+    jax.jit,
+    static_argnames=("interpret", "blocks_per_step", "mxu_native"),
 )
 def paged_decode_attention_pallas(
     q: jnp.ndarray,
@@ -127,10 +134,16 @@ def paged_decode_attention_pallas(
     *,
     interpret: bool = False,
     blocks_per_step: int = BLOCKS_PER_STEP,
+    mxu_native: bool = False,
 ) -> jnp.ndarray:
     """q: [B, H, D]; kv_layer: [num_blocks, 2, bs, Hkv, D];
     block_table: [B, max_blocks] int32; context_len: [B] int32.
-    Returns [B, H, D] in q.dtype."""
+    Returns [B, H, D] in q.dtype.
+
+    ``mxu_native=True`` keeps the attention dots in the input dtype
+    (bf16 operands, f32 accumulation) instead of upcasting K/V to f32 in
+    VMEM; bench.py's kernel sweep measures both and routes the winner.
+    """
     B, H, D = q.shape
     _, _, block_size, Hkv, _ = kv_layer.shape
     groups = H // Hkv
@@ -192,6 +205,7 @@ def paged_decode_attention_pallas(
         groups=groups,
         scale=D**-0.5,
         blocks_per_step=P_STEP,
+        mxu_native=mxu_native,
     )
     return pl.pallas_call(
         kernel,

@@ -77,6 +77,25 @@ def test_blocks_per_step_variants_match(blocks_per_step):
     )
 
 
+def test_mxu_native_variant_matches():
+    """The bf16-operand (mxu_native) dot path must agree with the f32
+    upcast path within bf16 tolerance; bench.py times both on the chip."""
+    bs = 16
+    q, kv, table, ctx_arr = make_case(
+        jax.random.PRNGKey(3), 2, 8, 4, 64, 64, bs, 7, [97, 33]
+    )
+    ref = paged_attention(q, kv, table, ctx_arr)
+    got = paged_decode_attention_pallas(
+        q, kv, table, ctx_arr, interpret=True, mxu_native=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32),
+        np.asarray(ref, np.float32),
+        rtol=0.05,
+        atol=0.05,
+    )
+
+
 def test_context_one_token():
     """ctx=1: only the first slot of the first block is visible."""
     bs = 16
