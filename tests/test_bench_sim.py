@@ -216,3 +216,71 @@ class TestHarness:
         c = poisson_arrivals(10.0, 5, seed=4)
         assert a == b != c
         assert all(x < y for x, y in zip(a, a[1:]))
+
+
+class TestDriverContract:
+    """The driver runs `python bench.py` under an unknown timeout and
+    parses the one JSON line; these guards pin the degrade-don't-die
+    behavior end to end in a real subprocess (tiny geometry, CPU)."""
+
+    @staticmethod
+    def _run(extra_env):
+        import json
+        import os
+        import subprocess
+        import sys
+
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            # Ambient knobs (an exported KVTPU_BENCH_BUDGET_S, say)
+            # must not leak in and flip the truncation asserts.
+            if not k.startswith("KVTPU_BENCH_")
+        }
+        env.update(
+            KVTPU_BENCH_PLATFORM="cpu",
+            KVTPU_BENCH_TINY="1",
+            JAX_PLATFORMS="cpu",
+        )
+        env.update(extra_env)
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "bench.py"],
+            cwd=here,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=500,
+        )
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        return json.loads(proc.stdout), proc.stderr
+
+    def test_full_tiny_run_emits_all_layers(self):
+        # Malformed knobs ride along: they must fall back to defaults
+        # (so this stays a FULL run) with a stderr note — asserting the
+        # env-fallback contract without paying a third subprocess run.
+        result, stderr = self._run(
+            {
+                "KVTPU_BENCH_BUDGET_S": "half-an-hour",
+                "KVTPU_BENCH_DEVICE_TIMEOUT_S": "900s",
+            }
+        )
+        detail = result["detail"]
+        assert result["value"] > 0
+        assert not detail["headline_seeds_truncated"]
+        assert not detail["matrix_truncated"]
+        assert not detail["decode_truncated"]
+        assert len(detail["matrix"]) == 32  # 5x5 ladder + 5 churn + 2 restart
+        assert "[bench +" in stderr  # phase progress lines
+        assert detail["budget_s"] == 2100.0
+        assert "ignoring malformed" in stderr
+
+    def test_tight_budget_degrades_not_dies(self):
+        result, _ = self._run({"KVTPU_BENCH_BUDGET_S": "1"})
+        detail = result["detail"]
+        # Headline still present and real; optional layers flagged.
+        assert result["value"] > 0
+        assert len(detail["headline_seeds"]) >= 1
+        assert detail["decode_truncated"]
+        assert detail["matrix_truncated"]
+        assert detail["decode_tok_s_per_seq"] is None
