@@ -650,9 +650,10 @@ def run_fleet(
     prefill_suffix,
     arrivals: Sequence[float],
     readback_rtt: float = 0.0,
-) -> Tuple[List[float], float]:
+) -> Tuple[List[float], float, List[float]]:
     """Run the request stream under one scheduler; returns (TTFTs, hit
-    rate).  A fresh indexer + event pool + pods per run.
+    rate, per-request routing seconds).  A fresh indexer + event pool +
+    pods per run.
 
     Open-loop load model (the reference's headline regime —
     BASELINE.md §1: Poisson arrivals at fixed QPS against N pods, where
@@ -664,11 +665,13 @@ def run_fleet(
     ``TTFT = routing + (start - arrival) + service``."""
     fleet = FleetRouter(scheduler, with_kv=True, params=params)
     ttfts: List[float] = []
+    routings: List[float] = []
     hits = 0
     try:
         for (group, text, tokens), arrival in zip(requests, arrivals):
             hashes = block_hash_chain(tokens)
             pod, routing_seconds = fleet.route(text, hashes)
+            routings.append(routing_seconds)
             hit, first_new, block_ids, evicted = fleet.account(
                 pod, hashes
             )
@@ -705,7 +708,7 @@ def run_fleet(
             )
     finally:
         fleet.shutdown()
-    return ttfts, hits / len(requests)
+    return ttfts, hits / len(requests), routings
 
 
 # ---------------- compute layers (detail.mfu / detail.kernels) ----------
@@ -1249,6 +1252,7 @@ def main() -> None:
     # seeds — one Poisson draw has ~±10-20% noise (burned r2->r3), so
     # the reported value is the median seed and the spread is explicit.
     per_seed: List[dict] = []
+    routing_samples: List[float] = []
     headline_truncated = False
     for seed in ARRIVAL_SEEDS:
         if per_seed and _over_budget(reserve_s=180.0):
@@ -1261,13 +1265,19 @@ def main() -> None:
             break
         _progress(f"headline seed {seed}: real-compute fleet runs")
         arrivals = poisson_arrivals(qps, len(requests), seed)
-        rr_ttfts, rr_hit = run_fleet(
+        rr_ttfts, rr_hit, _ = run_fleet(
             "round_robin", requests, params, prefill_full,
             prefill_suffix, arrivals, readback_rtt,
         )
-        pr_ttfts, pr_hit = run_fleet(
+        pr_ttfts, pr_hit, pr_routings = run_fleet(
             "precise", requests, params, prefill_full, prefill_suffix,
             arrivals, readback_rtt,
+        )
+        # Steady-state only, matching the TTFT percentiles below: the
+        # warmup requests route against a cold index (cheap lookups,
+        # first-call setup) and would bias the scoring-RPC stats.
+        routing_samples.extend(
+            r for i, r in enumerate(pr_routings) if i not in warmup_idx
         )
         rr_steady = [
             t for i, t in enumerate(rr_ttfts) if i not in warmup_idx
@@ -1326,6 +1336,21 @@ def main() -> None:
                         "max": by_speedup[-1]["speedup"],
                     },
                     "qps": round(qps, 2),
+                    # The scoring RPC's own cost (reference: index
+                    # microbench axis): tokenize -> hash -> lookup ->
+                    # score per request, inside the precise runs.
+                    "routing_precise_us": {
+                        "p50": round(
+                            float(np.percentile(routing_samples, 50))
+                            * 1e6,
+                            1,
+                        ),
+                        "p99": round(
+                            float(np.percentile(routing_samples, 99))
+                            * 1e6,
+                            1,
+                        ),
+                    },
                     "service_miss_s": round(t_miss, 4),
                     "service_hit_s": round(t_hit, 4),
                     "readback_rtt_s": round(readback_rtt, 4),
