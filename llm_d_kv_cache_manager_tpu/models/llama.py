@@ -37,6 +37,9 @@ from llm_d_kv_cache_manager_tpu.ops.paged_decode_pallas import (
     paged_decode_attention_pallas,
 )
 from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
+from llm_d_kv_cache_manager_tpu.ops.ring_attention import (
+    ring_attention_sharded,
+)
 
 Params = Dict[str, Any]
 
@@ -221,17 +224,43 @@ def forward(
     cfg: LlamaConfig,
     positions: Optional[jnp.ndarray] = None,
     use_flash: bool = True,
+    sp_mesh=None,
 ) -> jnp.ndarray:
-    """Dense forward: tokens [B, T] -> logits [B, T, V]."""
+    """Dense forward: tokens [B, T] -> logits [B, T, V].
+
+    ``sp_mesh``: a Mesh with an ``sp`` axis routes attention through
+    ring attention (ops/ring_attention.py) — the long-context prefill
+    path: activations stay sequence-sharded over ``sp``, K/V chunks
+    rotate over ICI, and only attention crosses devices.  Inference
+    path (no custom VJP; train through the dense/flash route).  The
+    ring's causal mask derives from each chunk's ring position, i.e.
+    global positions 0..T-1 — custom ``positions`` are rejected rather
+    than silently mismasked.
+    """
     B, T = tokens.shape
+    if sp_mesh is not None and positions is not None:
+        raise ValueError(
+            "sp_mesh ring attention assumes default positions 0..T-1 "
+            "(its causal mask is derived from ring chunk indices); "
+            "custom positions would be RoPE-rotated but mis-masked"
+        )
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T), (B, T))
     x = jnp.take(params["embed"], tokens, axis=0)
+    ring = None
+    if sp_mesh is not None:
+        ring = ring_attention_sharded(
+            sp_mesh,
+            batch_axis="dp" if "dp" in sp_mesh.axis_names else None,
+        )
 
     def layer(x, lp):
         h = _rms_norm(x, lp["ln1"])
         q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
-        attn = _prefill_attention(q, k, v, cfg, use_flash=use_flash)
+        if ring is not None:
+            attn = ring(q, k, v)
+        else:
+            attn = _prefill_attention(q, k, v, cfg, use_flash=use_flash)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
         return x, None
@@ -418,12 +447,23 @@ def decode_step(
 def loss_fn(
     params: Params, tokens: jnp.ndarray, cfg: LlamaConfig
 ) -> jnp.ndarray:
-    """Next-token cross entropy over tokens [B, T]."""
-    logits = forward(params, tokens[:, :-1], cfg, use_flash=False)
-    targets = tokens[:, 1:]
+    """Next-token cross entropy over tokens [B, T].
+
+    Shift-and-mask, not slice: ``tokens[:, :-1]`` inside jit makes an
+    unevenly-sharded [B, T-1] intermediate when T is sharded over
+    ``sp`` — XLA pads the short shard and the padded lanes' softmax
+    backward emits NaN into the target-token embedding row (seen on
+    sp x tp / sp x pp meshes).  Keeping every shape [B, T] and masking
+    the final position is mathematically identical (causality: logits
+    for positions < T-1 cannot see token T-1).
+    """
+    T = tokens.shape[1]
+    logits = forward(params, tokens, cfg, use_flash=False)
+    targets = jnp.roll(tokens, -1, axis=1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    mask = (jnp.arange(T) < T - 1).astype(nll.dtype)
+    return (nll * mask).sum() / (tokens.shape[0] * (T - 1))
 
 
 def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:

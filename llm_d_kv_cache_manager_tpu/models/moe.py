@@ -266,12 +266,23 @@ def forward(
 def loss_fn(
     params: Params, tokens: jnp.ndarray, cfg: MoEConfig
 ) -> jnp.ndarray:
-    """Next-token cross entropy + router load-balancing loss."""
-    logits, aux = forward(params, tokens[:, :-1], cfg, use_flash=False)
-    targets = tokens[:, 1:]
+    """Next-token cross entropy + router load-balancing loss.
+
+    Shift-and-mask like llama.loss_fn: slicing to [B, T-1] inside jit
+    breaks even sequence sharding over ``sp`` (padded-lane softmax
+    backward NaNs the target embedding row on combined meshes).  The
+    cross-entropy term is identical to the sliced form; the router aux
+    term now covers all T positions' routing instead of T-1 — a
+    deliberate (and slightly more truthful) change of the balance
+    statistic, not an equivalence."""
+    T = tokens.shape[1]
+    logits, aux = forward(params, tokens, cfg, use_flash=False)
+    targets = jnp.roll(tokens, -1, axis=1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean() + cfg.router_aux_weight * aux
+    mask = (jnp.arange(T) < T - 1).astype(nll.dtype)
+    nll_mean = (nll * mask).sum() / (tokens.shape[0] * (T - 1))
+    return nll_mean + cfg.router_aux_weight * aux
 
 
 def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:

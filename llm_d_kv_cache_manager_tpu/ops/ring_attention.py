@@ -96,6 +96,28 @@ def _ring_attention_local(
     return o.reshape(B, Tq, H, D).astype(q.dtype)
 
 
+def ring_attention_sharded(
+    mesh: Mesh,
+    axis_name: str = "sp",
+    batch_axis: Optional[str] = "dp",
+):
+    """The in-jit form: returns a callable ``(q, k, v) -> out`` over
+    already-sharded [B, T, H(kv), D] arrays (T over ``axis_name``, B
+    over ``batch_axis``).  Model code calls this inside its own jit —
+    shard_map composes under jit; no device_put happens here.  Head/dim
+    axes replicated over sp — shard heads over ``tp`` outside if
+    combining tp×sp."""
+    bspec = batch_axis if batch_axis else None
+    spec = P(bspec, axis_name, None, None)
+    local = functools.partial(_ring_attention_local, axis_name=axis_name)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -104,18 +126,11 @@ def ring_attention(
     axis_name: str = "sp",
     batch_axis: Optional[str] = "dp",
 ) -> jnp.ndarray:
-    """Shard q/k/v ([B, T, H, D]) on T over ``axis_name`` (and B over
-    ``batch_axis`` if given) and run the ring. Head/dim axes replicated
-    over sp — shard heads over ``tp`` outside if combining tp×sp."""
+    """Eager convenience: place q/k/v ([B, T, H, D]; T sharded over
+    ``axis_name``, B over ``batch_axis``) and run the ring."""
     bspec = batch_axis if batch_axis else None
     spec = P(bspec, axis_name, None, None)
-    local = functools.partial(_ring_attention_local, axis_name=axis_name)
-    fn = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-    )
+    fn = ring_attention_sharded(mesh, axis_name, batch_axis)
     q = jax.device_put(q, NamedSharding(mesh, spec))
     k = jax.device_put(k, NamedSharding(mesh, spec))
     v = jax.device_put(v, NamedSharding(mesh, spec))
