@@ -249,9 +249,22 @@ def forward(
     x = jnp.take(params["embed"], tokens, axis=0)
     ring = None
     if sp_mesh is not None:
+
+        def axis_if_used(name):
+            return (
+                name
+                if name in sp_mesh.axis_names
+                and sp_mesh.shape[name] > 1
+                else None
+            )
+
+        # Heads ride their tp sharding into the ring (q/k/v come out of
+        # tp-sharded wq/wk/wv head-sharded); declaring them replicated
+        # would all-gather them across tp every layer.
         ring = ring_attention_sharded(
             sp_mesh,
-            batch_axis="dp" if "dp" in sp_mesh.axis_names else None,
+            batch_axis=axis_if_used("dp"),
+            head_axis=axis_if_used("tp"),
         )
 
     def layer(x, lp):
@@ -444,26 +457,35 @@ def decode_step(
 # ---------------------------------------------------------------- training
 
 
-def loss_fn(
-    params: Params, tokens: jnp.ndarray, cfg: LlamaConfig
+def next_token_nll(
+    logits: jnp.ndarray, tokens: jnp.ndarray
 ) -> jnp.ndarray:
-    """Next-token cross entropy over tokens [B, T].
+    """Mean next-token cross entropy from full-length [B, T, V] logits.
 
     Shift-and-mask, not slice: ``tokens[:, :-1]`` inside jit makes an
     unevenly-sharded [B, T-1] intermediate when T is sharded over
     ``sp`` — XLA pads the short shard and the padded lanes' softmax
     backward emits NaN into the target-token embedding row (seen on
     sp x tp / sp x pp meshes).  Keeping every shape [B, T] and masking
-    the final position is mathematically identical (causality: logits
-    for positions < T-1 cannot see token T-1).
+    the final position avoids that; shared by the llama and MoE losses
+    so the sharding-sensitive masking lives in one place.
     """
-    T = tokens.shape[1]
-    logits = forward(params, tokens, cfg, use_flash=False)
+    B, T = tokens.shape
     targets = jnp.roll(tokens, -1, axis=1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = (jnp.arange(T) < T - 1).astype(nll.dtype)
-    return (nll * mask).sum() / (tokens.shape[0] * (T - 1))
+    return (nll * mask).sum() / (B * (T - 1))
+
+
+def loss_fn(
+    params: Params, tokens: jnp.ndarray, cfg: LlamaConfig
+) -> jnp.ndarray:
+    """Next-token cross entropy over tokens [B, T] — identical to the
+    sliced form (causality: logits for positions < T-1 cannot see token
+    T-1), in the sharding-safe shape (see next_token_nll)."""
+    logits = forward(params, tokens, cfg, use_flash=False)
+    return next_token_nll(logits, tokens)
 
 
 def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:

@@ -33,6 +33,7 @@ from llm_d_kv_cache_manager_tpu.models.llama import (
     _prefill_attention,
     _qkv,
     _rms_norm,
+    next_token_nll,
 )
 
 Params = Dict[str, Any]
@@ -268,20 +269,18 @@ def loss_fn(
 ) -> jnp.ndarray:
     """Next-token cross entropy + router load-balancing loss.
 
-    Shift-and-mask like llama.loss_fn: slicing to [B, T-1] inside jit
-    breaks even sequence sharding over ``sp`` (padded-lane softmax
-    backward NaNs the target embedding row on combined meshes).  The
-    cross-entropy term is identical to the sliced form; the router aux
-    term now covers all T positions' routing instead of T-1 — a
-    deliberate (and slightly more truthful) change of the balance
-    statistic, not an equivalence."""
-    T = tokens.shape[1]
+    Shift-and-mask (llama.next_token_nll): slicing to [B, T-1] inside
+    jit breaks even sequence sharding over ``sp`` (padded-lane softmax
+    backward NaNs the target embedding row on combined meshes).
+
+    NOT loss-curve-identical to the old sliced form: routing couples
+    tokens (expert capacity is consumed in token order), so including
+    position T-1 can change which earlier tokens are dropped under
+    capacity pressure — and the aux balance statistic now covers all T
+    positions.  A deliberate semantics change accepted with the
+    sharding fix."""
     logits, aux = forward(params, tokens, cfg, use_flash=False)
-    targets = jnp.roll(tokens, -1, axis=1)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = (jnp.arange(T) < T - 1).astype(nll.dtype)
-    nll_mean = (nll * mask).sum() / (tokens.shape[0] * (T - 1))
+    nll_mean = next_token_nll(logits, tokens)
     return nll_mean + cfg.router_aux_weight * aux
 
 

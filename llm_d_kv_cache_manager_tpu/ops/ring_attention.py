@@ -100,15 +100,21 @@ def ring_attention_sharded(
     mesh: Mesh,
     axis_name: str = "sp",
     batch_axis: Optional[str] = "dp",
+    head_axis: Optional[str] = None,
 ):
     """The in-jit form: returns a callable ``(q, k, v) -> out`` over
     already-sharded [B, T, H(kv), D] arrays (T over ``axis_name``, B
     over ``batch_axis``).  Model code calls this inside its own jit —
-    shard_map composes under jit; no device_put happens here.  Head/dim
-    axes replicated over sp — shard heads over ``tp`` outside if
-    combining tp×sp."""
+    shard_map composes under jit; no device_put happens here.
+
+    ``head_axis`` (e.g. ``"tp"``) shards the head dimension too — the
+    tp×sp composition: each shard runs the ring over its own head
+    slice (attention is head-independent; GQA group count is preserved
+    since H and Hkv divide by the same degree).  Left None, heads are
+    replicated over the mesh and tp-sharded inputs would be
+    all-gathered per call."""
     bspec = batch_axis if batch_axis else None
-    spec = P(bspec, axis_name, None, None)
+    spec = P(bspec, axis_name, head_axis, None)
     local = functools.partial(_ring_attention_local, axis_name=axis_name)
     return jax.shard_map(
         local,

@@ -218,6 +218,36 @@ def test_forward_sp_mesh_matches_dense(params):
     )
 
 
+def test_forward_sp_tp_mesh_matches_dense(params):
+    """tp x sp composition: params head-sharded over tp, sequence over
+    sp — the ring runs per head-shard (no per-layer all-gather of
+    q/k/v) and must still match the dense forward."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(MeshPlan(dp=1, tp=2, sp=2), jax.devices()[:4])
+    pspecs = llama.param_pspecs(CFG)
+    sharded = jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(10), (2, 32), 0, CFG.vocab_size
+    )
+    tokens_sharded = jax.device_put(
+        tokens, NamedSharding(mesh, P(None, "sp"))
+    )
+    ring_logits = jax.jit(
+        lambda p, t: llama.forward(p, t, CFG, sp_mesh=mesh)
+    )(sharded, tokens_sharded)
+    dense = llama.forward(params, tokens, CFG)
+    np.testing.assert_allclose(
+        np.asarray(ring_logits),
+        np.asarray(dense),
+        rtol=2e-4,
+        atol=2e-4,
+    )
+
+
 def test_sharded_train_step_params_stay_finite(params):
     """Regression: under combined sp x tp sharding, the old
     slice-to-[B, T-1] loss made XLA pad the short sequence shard and
