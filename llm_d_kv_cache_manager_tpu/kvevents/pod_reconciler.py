@@ -201,10 +201,26 @@ class PodReconciler:
             self.subscriber_manager.remove_subscriber(key)
 
     def reconcile_list(self, pod_list: dict) -> str:
-        """Full resync from a list response; returns its resourceVersion."""
+        """Full resync from a list response; returns its resourceVersion.
+
+        Per-item poison skip like the watch path: one malformed pod in
+        the list must not abort the resync — run_once re-lists FIRST
+        every cycle, so an aborting item would wedge the reconciler for
+        as long as it exists."""
         seen = set()
         for pod in pod_list.get("items", []):
-            self.reconcile("MODIFIED", pod)
+            if not isinstance(pod, dict):
+                logger.warning("skipping malformed pod list item %r", pod)
+                continue
+            try:
+                self.reconcile("MODIFIED", pod)
+            except Exception:  # noqa: BLE001 - per-item poison skip
+                logger.warning(
+                    "skipping pod list item that failed to reconcile: %r",
+                    pod,
+                    exc_info=True,
+                )
+                continue
             seen.add(self._pod_key(pod))
         for pod_id in self.subscriber_manager.active_pods():
             # "/" distinguishes reconciler-owned ids from manual ones
@@ -222,6 +238,13 @@ class PodReconciler:
             for event in self.client.watch_pods(resource_version):
                 if self._stop.is_set():
                     return
+                if not isinstance(event, dict):
+                    # Valid JSON, wrong shape: skip the line rather than
+                    # abort the watch (poison-pill philosophy of
+                    # kvevents/pool.py; the stream itself is still
+                    # framed correctly).
+                    logger.warning("skipping malformed watch event %r", event)
+                    continue
                 kind = event.get("type", "")
                 if kind == "BOOKMARK":
                     continue
@@ -230,9 +253,19 @@ class PodReconciler:
                     logger.info("watch error event %s; re-listing", event)
                     return
                 obj = event.get("object", {})
+                if not isinstance(obj, dict):
+                    logger.warning("skipping malformed pod object %r", obj)
+                    continue
                 if obj.get("kind") not in (None, "Pod"):
                     continue
-                self.reconcile(kind, obj)
+                try:
+                    self.reconcile(kind, obj)
+                except Exception:  # noqa: BLE001 - per-event poison skip
+                    logger.warning(
+                        "skipping pod event that failed to reconcile: %r",
+                        obj,
+                        exc_info=True,
+                    )
         except (TimeoutError, socket.timeout):
             # Dead (half-open) stream: treat like a normal stream end and
             # let the loop re-list.  socket.timeout is only an alias of

@@ -514,3 +514,53 @@ class TestPodReconciler:
         reconciler.run_once()  # must not raise
         assert manager.active_pods() == ["llm-d/pod-a"]
         manager.shutdown()
+
+
+class TestReconcilerChaos:
+    """Garbled watch events must not abort the watch: type-confused
+    lines are skipped per-event (kvevents-pool poison philosophy) and
+    later valid events still converge the subscriber set."""
+
+    def test_garbage_events_skipped_valid_ones_applied(self, fake_kube):
+        FakeKubeHandler.pods = []
+        FakeKubeHandler.watch_events = [
+            42,  # not an object
+            "nope",
+            [1, 2, 3],
+            {"type": "ADDED", "object": "not-a-pod"},
+            {"type": "ADDED", "object": {"status": "confused"}},
+            {"type": 7, "object": {}},
+            {"type": "ADDED", "object": make_pod("pod-z", ip="10.1.0.9")},
+        ]
+        manager = RecordingManager()
+        reconciler = PodReconciler(
+            manager,
+            PodReconcilerConfig(
+                namespace="llm-d", api_server=fake_kube, token="t"
+            ),
+        )
+        reconciler.run_once()
+        # The single valid event at the end of the garbled stream landed.
+        assert manager.active_pods() == ["llm-d/pod-z"]
+        manager.shutdown()
+
+    def test_poison_pod_in_list_does_not_wedge_resync(self, fake_kube):
+        """A malformed pod in the LIST response (run_once re-lists
+        first, every cycle) must be skipped per-item — otherwise the
+        reconciler wedges for as long as the bad item exists."""
+        FakeKubeHandler.pods = [
+            42,
+            {"metadata": {"name": "bad"}, "status": "confused"},
+            make_pod("pod-good", ip="10.1.0.7"),
+        ]
+        FakeKubeHandler.watch_events = []
+        manager = RecordingManager()
+        reconciler = PodReconciler(
+            manager,
+            PodReconcilerConfig(
+                namespace="llm-d", api_server=fake_kube, token="t"
+            ),
+        )
+        reconciler.run_once()
+        assert manager.active_pods() == ["llm-d/pod-good"]
+        manager.shutdown()
