@@ -188,6 +188,22 @@ class PodReconciler:
 
     # -- reconciliation --
 
+    def _reconcile_safely(
+        self, event_type: str, pod: dict, context: str
+    ) -> None:
+        """The per-item poison-skip policy, shared by the list and watch
+        paths: a pod that fails to reconcile is logged and skipped,
+        never allowed to abort the cycle."""
+        try:
+            self.reconcile(event_type, pod)
+        except Exception:  # noqa: BLE001 - per-item poison skip
+            logger.warning(
+                "skipping pod %s that failed to reconcile: %r",
+                context,
+                pod,
+                exc_info=True,
+            )
+
     def reconcile(self, event_type: str, pod: dict) -> None:
         key = self._pod_key(pod)
         if event_type == "DELETED":
@@ -212,15 +228,11 @@ class PodReconciler:
             if not isinstance(pod, dict):
                 logger.warning("skipping malformed pod list item %r", pod)
                 continue
-            try:
-                self.reconcile("MODIFIED", pod)
-            except Exception:  # noqa: BLE001 - per-item poison skip
-                logger.warning(
-                    "skipping pod list item that failed to reconcile: %r",
-                    pod,
-                    exc_info=True,
-                )
-                continue
+            self._reconcile_safely("MODIFIED", pod, "list item")
+            # Seen regardless of reconcile outcome: a pod PRESENT in the
+            # list response must never be pruned below — a transient
+            # ensure_subscriber failure would otherwise tear down that
+            # pod's existing healthy subscription every resync.
             seen.add(self._pod_key(pod))
         for pod_id in self.subscriber_manager.active_pods():
             # "/" distinguishes reconciler-owned ids from manual ones
@@ -258,14 +270,7 @@ class PodReconciler:
                     continue
                 if obj.get("kind") not in (None, "Pod"):
                     continue
-                try:
-                    self.reconcile(kind, obj)
-                except Exception:  # noqa: BLE001 - per-event poison skip
-                    logger.warning(
-                        "skipping pod event that failed to reconcile: %r",
-                        obj,
-                        exc_info=True,
-                    )
+                self._reconcile_safely(kind, obj, "watch event")
         except (TimeoutError, socket.timeout):
             # Dead (half-open) stream: treat like a normal stream end and
             # let the loop re-list.  socket.timeout is only an alias of

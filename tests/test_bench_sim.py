@@ -272,7 +272,7 @@ class TestDriverContract:
         assert not detail["decode_truncated"]
         assert len(detail["matrix"]) == 32  # 5x5 ladder + 5 churn + 2 restart
         assert "[bench +" in stderr  # phase progress lines
-        assert detail["budget_s"] == 2100.0
+        assert detail["budget_s"] == 1500.0
         assert "ignoring malformed" in stderr
 
     def test_tight_budget_degrades_not_dies(self):

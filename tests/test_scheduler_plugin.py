@@ -564,3 +564,28 @@ class TestReconcilerChaos:
         reconciler.run_once()
         assert manager.active_pods() == ["llm-d/pod-good"]
         manager.shutdown()
+
+    def test_failed_reconcile_does_not_prune_existing_subscriber(
+        self, fake_kube
+    ):
+        """A pod PRESENT in the list whose reconcile raises (transient
+        failure, type confusion) keeps its existing subscription — the
+        stale-prune must only remove pods absent from the response."""
+        FakeKubeHandler.pods = [
+            {
+                "metadata": {"namespace": "llm-d", "name": "flaky"},
+                "status": "confused",  # reconcile raises on this
+            },
+        ]
+        FakeKubeHandler.watch_events = []
+        manager = RecordingManager()
+        manager.ensure_subscriber("llm-d/flaky", "tcp://10.3.0.1:5557")
+        reconciler = PodReconciler(
+            manager,
+            PodReconcilerConfig(
+                namespace="llm-d", api_server=fake_kube, token="t"
+            ),
+        )
+        reconciler.run_once()
+        assert manager.active_pods() == ["llm-d/flaky"]
+        manager.shutdown()
