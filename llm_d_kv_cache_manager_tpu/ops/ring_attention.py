@@ -12,6 +12,15 @@ through chunked hashing" scope note (SURVEY §2.3): here long prompts
 also *compute* in chunks, across chips.  Use under ``shard_map`` with
 q/k/v sharded on the sequence axis, or via ``ring_attention`` which
 wraps the shard_map given a mesh.
+
+Known performance note: contiguous chunking under causal masking is
+load-imbalanced — device 0's queries finish attending after one step
+while the last device works every step (utilization ~(R+1)/2R of peak
+for ring size R).  Striped/zigzag layouts rebalance this by
+interleaving token stripes per device at the cost of a global
+permutation and stripe-aware masks; at the dryrun scale and current
+prefill shapes the simple contiguous ring is preferred for its
+exactness against the dense reference and simpler block tables.
 """
 
 from __future__ import annotations
