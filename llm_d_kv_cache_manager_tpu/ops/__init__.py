@@ -2,17 +2,28 @@
 
 - ``attention``: dense causal GQA (prefill / training path).
 - ``ring_attention``: sequence-parallel blockwise attention over an
-  ``sp`` mesh axis (ppermute ring over ICI) for long-context prefill.
+  ``sp`` mesh axis (ppermute ring over ICI) for long-context prefill;
+  ``striped=True`` + ``stripe``/``unstripe`` select the interleaved
+  layout whose causal masks balance across ring steps (the foundation
+  for a mask-aware kernel; see the module docstring's scoping note).
 - ``paged_attention``: decode-time attention over the paged KV pool
   (block-table gather), the TPU analogue of vLLM's paged attention.
 """
 
 from llm_d_kv_cache_manager_tpu.ops.attention import causal_gqa_attention
 from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
-from llm_d_kv_cache_manager_tpu.ops.ring_attention import ring_attention
+from llm_d_kv_cache_manager_tpu.ops.ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+    stripe,
+    unstripe,
+)
 
 __all__ = [
     "causal_gqa_attention",
     "ring_attention",
+    "ring_attention_sharded",
+    "stripe",
+    "unstripe",
     "paged_attention",
 ]

@@ -14,13 +14,17 @@ q/k/v sharded on the sequence axis, or via ``ring_attention`` which
 wraps the shard_map given a mesh.
 
 Known performance note: contiguous chunking under causal masking is
-load-imbalanced — device 0's queries finish attending after one step
-while the last device works every step (utilization ~(R+1)/2R of peak
-for ring size R).  Striped/zigzag layouts rebalance this by
-interleaving token stripes per device at the cost of a global
-permutation and stripe-aware masks; at the dryrun scale and current
-prefill shapes the simple contiguous ring is preferred for its
-exactness against the dense reference and simpler block tables.
+load-imbalanced — device 0's queries are fully masked after one step
+while the last device's stay visible every step.  ``striped=True``
+selects the rebalanced layout (tokens interleave across devices via
+``stripe``/``unstripe``; the causal mask becomes a near-uniform band
+per step).  Scope honestly: the CURRENT body computes the full
+Tq x Tk einsum and masks with where() in both layouts, so neither
+realizes FLOP savings yet — the striped layout is the foundation (its
+masks and exactness are pinned by tests) for a mask-aware inner
+kernel (Pallas sub-block skipping) where the balance converts into
+wall-clock.  The model's ``forward(sp_mesh=...)`` keeps the
+contiguous ring (simpler block tables, exactness-tested).
 """
 
 from __future__ import annotations
@@ -30,6 +34,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
@@ -42,10 +47,19 @@ def _ring_attention_local(
     k: jnp.ndarray,
     v: jnp.ndarray,
     axis_name: str,
+    striped: bool = False,
 ) -> jnp.ndarray:
     """Per-device body. q/k/v: [B, T_local, H(kv), D]; causal over the
-    global sequence; chunk i of the ring holds positions
-    [i*T_local, (i+1)*T_local)."""
+    global sequence.
+
+    ``striped=False``: chunk i holds contiguous positions
+    [i*T_local, (i+1)*T_local).  ``striped=True``: chunk i holds the
+    interleaved stripe {t : t % R == i} in ascending order (see
+    ``stripe``), so local row a on chunk c is global position a*R + c —
+    the causal mask becomes the near-uniform band ``b <= a - (src >
+    my_idx)`` and every device does almost equal work at every ring
+    step (the contiguous layout leaves early chunks idle once their
+    queries are past all rotated keys)."""
     B, Tq, H, D = q.shape
     _, Tk, Hkv, _ = k.shape
     groups = H // Hkv
@@ -69,9 +83,17 @@ def _ring_attention_local(
         scores = jnp.einsum(
             "bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32)
         )
-        q_pos = my_idx * Tq + jnp.arange(Tq)[:, None]
-        k_pos = src * Tk + jnp.arange(Tk)[None, :]
-        mask = k_pos <= q_pos  # [Tq, Tk] causal over global positions
+        if striped:
+            # Global positions: query a*R + my_idx vs key b*R + src;
+            # b*R + src <= a*R + my_idx  <=>  b <= a - (src > my_idx).
+            mask = jnp.arange(Tk)[None, :] <= (
+                jnp.arange(Tq)[:, None]
+                - (src > my_idx).astype(jnp.int32)
+            )
+        else:
+            q_pos = my_idx * Tq + jnp.arange(Tq)[:, None]
+            k_pos = src * Tk + jnp.arange(Tk)[None, :]
+            mask = k_pos <= q_pos  # [Tq, Tk] causal, global positions
         scores = jnp.where(mask[None, None, None], scores, NEG_INF)
 
         m_new = jnp.maximum(m, scores.max(axis=-1))
@@ -105,11 +127,34 @@ def _ring_attention_local(
     return o.reshape(B, Tq, H, D).astype(q.dtype)
 
 
+def stripe(x: jnp.ndarray, ring_size: int, axis: int = 1) -> jnp.ndarray:
+    """Permute a sequence axis into the striped ring layout: global
+    token t goes to chunk t % ring_size, slot t // ring_size — so that
+    sharding the result contiguously over the ring gives each device an
+    interleaved stripe.  Static permutation (trace-time indices)."""
+    T = x.shape[axis]
+    if T % ring_size:
+        raise ValueError(f"sequence {T} not divisible by ring {ring_size}")
+    idx = np.arange(T).reshape(T // ring_size, ring_size).T.reshape(-1)
+    return jnp.take(x, jnp.asarray(idx), axis=axis)
+
+
+def unstripe(x: jnp.ndarray, ring_size: int, axis: int = 1) -> jnp.ndarray:
+    """Inverse of :func:`stripe` — which is itself a stripe with the
+    complementary factor (the permutation t -> (t % R)*(T/R) + t//R is
+    inverted by the same map with R' = T/R)."""
+    T = x.shape[axis]
+    if T % ring_size:
+        raise ValueError(f"sequence {T} not divisible by ring {ring_size}")
+    return stripe(x, T // ring_size, axis=axis)
+
+
 def ring_attention_sharded(
     mesh: Mesh,
     axis_name: str = "sp",
     batch_axis: Optional[str] = "dp",
     head_axis: Optional[str] = None,
+    striped: bool = False,
 ):
     """The in-jit form: returns a callable ``(q, k, v) -> out`` over
     already-sharded [B, T, H(kv), D] arrays (T over ``axis_name``, B
@@ -121,10 +166,19 @@ def ring_attention_sharded(
     slice (attention is head-independent; GQA group count is preserved
     since H and Hkv divide by the same degree).  Left None, heads are
     replicated over the mesh and tp-sharded inputs would be
-    all-gathered per call."""
+    all-gathered per call.
+
+    ``striped=True`` expects q/k/v already in the :func:`stripe` layout
+    (and returns output in it — :func:`unstripe` after): the causal
+    work balances across ring steps instead of concentrating on the
+    last chunks.  RoPE/position embeddings must be applied BEFORE
+    striping (or with striped position vectors) — positions are
+    physical token indices, not stripe slots."""
     bspec = batch_axis if batch_axis else None
     spec = P(bspec, axis_name, head_axis, None)
-    local = functools.partial(_ring_attention_local, axis_name=axis_name)
+    local = functools.partial(
+        _ring_attention_local, axis_name=axis_name, striped=striped
+    )
     return jax.shard_map(
         local,
         mesh=mesh,
@@ -140,13 +194,28 @@ def ring_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     batch_axis: Optional[str] = "dp",
+    striped: bool = False,
 ) -> jnp.ndarray:
     """Eager convenience: place q/k/v ([B, T, H, D]; T sharded over
-    ``axis_name``, B over ``batch_axis``) and run the ring."""
+    ``axis_name``, B over ``batch_axis``) and run the ring.
+
+    With ``striped=True`` the inputs/output are in PHYSICAL token order
+    — this wrapper stripes them in, runs the balanced ring, and
+    unstripes the output."""
+    ring_size = mesh.shape[axis_name]
+    if striped:
+        q = stripe(q, ring_size)
+        k = stripe(k, ring_size)
+        v = stripe(v, ring_size)
     bspec = batch_axis if batch_axis else None
     spec = P(bspec, axis_name, None, None)
-    fn = ring_attention_sharded(mesh, axis_name, batch_axis)
+    fn = ring_attention_sharded(
+        mesh, axis_name, batch_axis, striped=striped
+    )
     q = jax.device_put(q, NamedSharding(mesh, spec))
     k = jax.device_put(k, NamedSharding(mesh, spec))
     v = jax.device_put(v, NamedSharding(mesh, spec))
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    if striped:
+        out = unstripe(out, ring_size)
+    return out
