@@ -18,6 +18,7 @@ from __future__ import annotations
 import http.server
 import json
 import os
+import socket
 import threading
 from typing import Optional
 
@@ -38,14 +39,32 @@ MAX_BODY_BYTES = 16 * 1024 * 1024
 def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # Socket timeout (StreamRequestHandler applies it in setup()):
+        # without one, a client that declares a Content-Length and goes
+        # silent wedges a handler thread forever in rfile.read — a few
+        # dozen such connections exhaust the ThreadingHTTPServer.
+        timeout = 60
 
         def log_message(self, *args):  # route through our logger
             logger.debug("http: " + args[0], *args[1:])
 
         def _reply(self, status: int, body: bytes, content_type: str):
+            # Centralized desync guard: replying while a declared
+            # request body sits unconsumed (404 route, 403 admin gate,
+            # any future early-reply path) leaves those bytes to be
+            # parsed as the next request line on keep-alive.  Close —
+            # and TELL the client (without the Connection: close
+            # header a keep-alive pool marks the connection reusable
+            # and its next non-idempotent POST dies with ECONNRESET).
+            if not getattr(
+                self, "_body_consumed", True
+            ) and self._declares_body():
+                self.close_connection = True
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            if self.close_connection:
+                self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.write(body)
 
@@ -58,28 +77,59 @@ def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
             self._reply(status, message.encode() + b"\n", "text/plain")
 
         def _read_json(self) -> Optional[dict]:
-            try:
-                length = int(self.headers.get("Content-Length", 0))
-            except ValueError:
+            # A chunked body is never decoded here, so its framing bytes
+            # would sit in the buffer and be parsed as the next request
+            # line — the keep-alive desync the paths below guard
+            # against.  Reject the encoding outright.
+            if self.headers.get("Transfer-Encoding"):
+                self.close_connection = True
+                self._error(501, "Transfer-Encoding not supported")
+                return None
+            # Duplicate Content-Length headers are a request-smuggling
+            # primitive: .get() would silently honor the first value and
+            # leave the rest of the body buffered for the next request
+            # line.  Reject conflicting duplicates outright.
+            all_lengths = self.headers.get_all("Content-Length") or ["0"]
+            if len(set(all_lengths)) > 1:
+                self.close_connection = True
+                self._error(400, "conflicting Content-Length headers")
+                return None
+            # Strict digit grammar, same policy as RespClient._parse_int:
+            # Python's int() accepts ' 10 ', '+10' and '1_0', which are
+            # corrupted headers, not lengths.  ASCII digits only also
+            # rules out negatives (read-to-EOF wedge) by construction.
+            raw_length = str(all_lengths[0])
+            # The digit-count bound precedes int(): CPython (>=3.11)
+            # raises ValueError past ~4300 digits of str->int, which
+            # would escape the handler; anything longer than
+            # len(str(MAX_BODY_BYTES)) digits is oversized regardless.
+            if (
+                not raw_length.isascii()
+                or not raw_length.isdigit()
+                or len(raw_length) > len(str(MAX_BODY_BYTES))
+            ):
                 # Rejecting without consuming the body desyncs HTTP/1.1
                 # keep-alive (leftover bytes parse as the next request
                 # line); drop the connection instead.
                 self.close_connection = True
                 self._error(400, "invalid Content-Length")
                 return None
-            # A negative length would turn rfile.read into read-to-EOF —
-            # one crafted header wedges the handler thread until the
-            # client hangs up; an unbounded one buffers arbitrary bytes.
-            if length < 0:
-                self.close_connection = True
-                self._error(400, "invalid Content-Length")
-                return None
+            length = int(raw_length)
             if length > MAX_BODY_BYTES:
                 self.close_connection = True
                 self._error(413, "request body too large")
                 return None
             try:
-                obj = json.loads(self.rfile.read(length))
+                body = self.rfile.read(length)
+            except (TimeoutError, socket.timeout, OSError):
+                # Stalled client (declared length, stopped sending):
+                # the socket timeout fired mid-read.  The connection is
+                # in an unknown framing state — drop it.
+                self.close_connection = True
+                return None
+            self._body_consumed = True
+            try:
+                obj = json.loads(body)
             except (ValueError, json.JSONDecodeError):
                 self._error(400, "invalid JSON body")
                 return None
@@ -91,7 +141,7 @@ def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
                 return None
             return obj
 
-        def do_GET(self):
+        def _do_get(self):
             if self.path == "/metrics":
                 self._reply(
                     200,
@@ -103,15 +153,42 @@ def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
             else:
                 self._error(404, "not found")
 
+        def _declares_body(self) -> bool:
+            if self.headers.get("Transfer-Encoding"):
+                return True
+            # get_all: a conflicting duplicate pair like ('0', '100')
+            # must count as declaring a body, or the smuggling guard
+            # below is bypassed on paths that never reach _read_json.
+            lengths = self.headers.get_all("Content-Length") or []
+            return any(str(raw).strip() not in ("", "0") for raw in lengths)
+
         def do_POST(self):
-            if self.path == "/score_completions":
-                self._score_completions()
-            elif self.path == "/score_chat_completions":
-                self._score_chat_completions()
-            elif self.path == "/admin/purge_pod":
-                self._purge_pod()
-            else:
-                self._error(404, "not found")
+            # Replies sent before the body is consumed (404 route, 403
+            # admin gate, field validation) leave the body bytes
+            # buffered to be parsed as the next request line on
+            # keep-alive.  _read_json marks consumption; any exit
+            # without it drops the connection.
+            self._body_consumed = False
+            try:
+                if self.path == "/score_completions":
+                    self._score_completions()
+                elif self.path == "/score_chat_completions":
+                    self._score_chat_completions()
+                elif self.path == "/admin/purge_pod":
+                    self._purge_pod()
+                else:
+                    self._error(404, "not found")
+            finally:
+                if not self._body_consumed and self._declares_body():
+                    self.close_connection = True
+
+        def do_GET(self):
+            # A GET that declares a body is pathological; its unread
+            # bytes would desync keep-alive exactly like the POST case.
+            # GET handlers never read a body, so marking it unconsumed
+            # lets _reply's centralized guard close when one is declared.
+            self._body_consumed = False
+            self._do_get()
 
         def _admin_allowed(self) -> bool:
             """Scoring is read-only; /admin/* mutates, so it gets its

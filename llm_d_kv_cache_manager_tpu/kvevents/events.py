@@ -20,6 +20,7 @@ reference's legacy-format handling (process_event_test.go:38-60).
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Union
 
@@ -107,6 +108,13 @@ def decode_event_batch(payload: bytes) -> EventBatch:
         ts = float(raw[0])
     except (TypeError, ValueError) as exc:
         raise EventDecodeError(f"batch ts is not a number: {raw[0]!r}") from exc
+    if not math.isfinite(ts):
+        # ts is currently write-only in this codebase, but a nan/inf
+        # timestamp is evidence the producer (or the wire) is corrupt —
+        # the whole batch is treated as a poison pill rather than
+        # trusting its events, and any future consumer of ts is
+        # guaranteed a finite value.
+        raise EventDecodeError(f"batch ts is not finite: {ts!r}")
     events = raw[1]
     if not isinstance(events, (list, tuple)):
         raise EventDecodeError("event batch events field is not an array")

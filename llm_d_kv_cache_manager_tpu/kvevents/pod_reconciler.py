@@ -163,7 +163,13 @@ class PodReconciler:
 
     @staticmethod
     def _pod_key(pod: dict) -> str:
-        metadata = pod.get("metadata", {})
+        # A list item can be a dict whose "metadata" is null/string/list;
+        # the key computation runs outside _reconcile_safely (reconcile_list
+        # marks pods "seen" regardless of reconcile outcome), so it must
+        # never raise — one poison pod would wedge every resync.
+        metadata = pod.get("metadata")
+        if not isinstance(metadata, dict):
+            metadata = {}
         return f"{metadata.get('namespace', '')}/{metadata.get('name', '')}"
 
     @staticmethod
@@ -224,7 +230,20 @@ class PodReconciler:
         every cycle, so an aborting item would wedge the reconciler for
         as long as it exists."""
         seen = set()
-        for pod in pod_list.get("items", []):
+        if not isinstance(pod_list, dict):
+            logger.warning("malformed pod list response %r", type(pod_list))
+            pod_list = {}
+        items = pod_list.get("items")
+        if not isinstance(items, (list, tuple)):
+            # Go serializes an empty slice as null; a proxy may mangle
+            # worse.  A malformed items field must not raise — run_once
+            # re-lists first every cycle, so raising here wedges the
+            # reconciler (no watch ever starts) for as long as the
+            # response shape persists.
+            if items is not None:
+                logger.warning("malformed pod list items %r", type(items))
+            items = []
+        for pod in items:
             if not isinstance(pod, dict):
                 logger.warning("skipping malformed pod list item %r", pod)
                 continue
@@ -239,7 +258,11 @@ class PodReconciler:
             # (e.g. the global-socket "local-subscriber").
             if "/" in pod_id and pod_id not in seen:
                 self.subscriber_manager.remove_subscriber(pod_id)
-        return pod_list.get("metadata", {}).get("resourceVersion", "0")
+        meta = pod_list.get("metadata")
+        if not isinstance(meta, dict):
+            meta = {}
+        version = meta.get("resourceVersion", "0")
+        return version if isinstance(version, str) else "0"
 
     # -- watch loop --
 

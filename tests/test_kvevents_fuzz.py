@@ -20,6 +20,7 @@ All randomness is seeded: failures reproduce exactly.
 import random
 
 import msgpack
+import pytest
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
     ChunkedTokenDatabase,
@@ -140,6 +141,14 @@ class TestDecoderTotality:
                 decode_event(event)
             except EventDecodeError:
                 pass
+
+    def test_nonfinite_batch_ts_rejected(self):
+        """A batch whose ts is nan/inf decodes without error into a
+        timestamp that poisons downstream ordering/latency math — it
+        must be rejected outright, not merely tolerated."""
+        for bad in (float("inf"), float("-inf"), float("nan")):
+            with pytest.raises(EventDecodeError):
+                decode_event_batch(msgpack.packb([bad, []]))
 
     def test_nonfinite_numeric_fields(self):
         """int(float('inf')) raises OverflowError — a third escape path

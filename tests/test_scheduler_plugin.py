@@ -551,6 +551,13 @@ class TestReconcilerChaos:
         FakeKubeHandler.pods = [
             42,
             {"metadata": {"name": "bad"}, "status": "confused"},
+            # Dict pod whose metadata itself is type-confused: the key
+            # computation runs OUTSIDE the per-item try (seen-marking),
+            # so _pod_key must tolerate these rather than raise and
+            # abort the whole resync.
+            {"metadata": None, "status": {"phase": "Running"}},
+            {"metadata": "nope", "status": {"phase": "Running"}},
+            {"metadata": [1, 2], "status": {"phase": "Running"}},
             make_pod("pod-good", ip="10.1.0.7"),
         ]
         FakeKubeHandler.watch_events = []
@@ -563,6 +570,31 @@ class TestReconcilerChaos:
         )
         reconciler.run_once()
         assert manager.active_pods() == ["llm-d/pod-good"]
+        manager.shutdown()
+
+    def test_malformed_list_response_does_not_raise(self, fake_kube):
+        """Go serializes an empty slice as null ({"items": null}); a
+        proxy may mangle worse.  reconcile_list must tolerate a
+        type-confused items/metadata field — run_once re-lists first
+        every cycle, so raising here wedges the reconciler for as long
+        as the response shape persists."""
+        manager = RecordingManager()
+        reconciler = PodReconciler(
+            manager,
+            PodReconcilerConfig(
+                namespace="llm-d", api_server=fake_kube, token="t"
+            ),
+        )
+        for bad_list in (
+            {"items": None, "metadata": None},
+            {"items": "nope", "metadata": "nope"},
+            {"items": 42, "metadata": {"resourceVersion": 7}},
+            {},
+            None,
+            "garbage",
+        ):
+            version = reconciler.reconcile_list(bad_list)
+            assert isinstance(version, str)
         manager.shutdown()
 
     def test_failed_reconcile_does_not_prune_existing_subscriber(
