@@ -168,7 +168,7 @@ class TestVirtualClock:
         n = bench.NUM_PODS + 1
         requests = [(0, "", tokens)] * n
         hashes_list = [block_hash_chain(tokens)] * n
-        ttfts, hit_rate, depth = run_fleet_virtual(
+        ttfts, hit_rate, depth, _ = run_fleet_virtual(
             "round_robin",
             requests,
             hashes_list,
@@ -191,14 +191,14 @@ class TestVirtualClock:
         hashes_list = [block_hash_chain(tokens)] * 4
         arrivals = [0.0, 10.0, 20.0, 30.0]
         # Precise: indexed state survives the reset -> 3 of 4 hit.
-        ttfts, hit_rate, _ = run_fleet_virtual(
+        ttfts, hit_rate, _, _ = run_fleet_virtual(
             "precise", requests, hashes_list, arrivals,
             t_miss=1.0, t_hit=0.1, seed=0, reset_history_at=2,
         )
         assert hit_rate == pytest.approx(0.75)
         # Estimated: history reset at 2 -> request 2 falls to rr and
         # can land on a cold pod; hit rate <= precise's.
-        _, est_hit, _ = run_fleet_virtual(
+        _, est_hit, _, _ = run_fleet_virtual(
             "estimated", requests, hashes_list, arrivals,
             t_miss=1.0, t_hit=0.1, seed=0, reset_history_at=2,
         )
@@ -271,6 +271,9 @@ class TestDriverContract:
         assert not detail["matrix_truncated"]
         assert not detail["decode_truncated"]
         assert len(detail["matrix"]) == 32  # 5x5 ladder + 5 churn + 2 restart
+        assert detail["service_times"] == "measured"
+        assert detail["routing_precise_us"]["p99"] > 0
+        assert detail["micro"]["index_lookup_us_per_chain"] > 0
         assert "[bench +" in stderr  # phase progress lines
         assert detail["budget_s"] == 1500.0
         assert "ignoring malformed" in stderr
@@ -284,3 +287,28 @@ class TestDriverContract:
         assert detail["decode_truncated"]
         assert detail["matrix_truncated"]
         assert detail["decode_tok_s_per_seq"] is None
+
+    def test_device_failure_emits_cpu_detail_not_empty_artifact(self):
+        """The r4 failure mode: a wedged chip produced value 0.0 and
+        NOTHING else.  On device-init failure the bench must still emit
+        every device-independent layer — matrix (all regimes, from
+        calibrated service times), scoring-RPC percentiles, and the
+        index/tokenization microbenches — alongside the explicit error
+        and a zeroed headline."""
+        result, stderr = self._run(
+            {
+                "KVTPU_BENCH_FORCE_DEVICE_ERROR": "wedge-simulation",
+            }
+        )
+        assert result["value"] == 0.0
+        assert result["vs_baseline"] == 0.0
+        assert "wedge-simulation" in result["error"]
+        detail = result["detail"]
+        assert detail["device"] == "cpu"
+        assert detail["service_times"] == "calibrated"
+        assert not detail["matrix_truncated"]
+        assert len(detail["matrix"]) == 32  # 5x5 ladder + 5 churn + 2 restart
+        assert detail["routing_precise_us"]["p99"] > 0
+        assert detail["micro"]["index_lookup_us_per_chain"] > 0
+        assert detail["micro"]["hash_chain_tok_s"] > 0
+        assert "CPU-detail fallback" in stderr
