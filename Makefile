@@ -9,8 +9,10 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 all: native test
 
 # Same invocation as CI's lint step (.github/workflows/ci.yaml); the
-# flags also live in .flake8 so a bare `flake8` agrees.  clang-format
-# is advisory until the tree is normalized with a real binary.
+# flags also live in .flake8 so a bare `flake8` agrees.  The native
+# format gate is HARD: real clang-format when installed, and always
+# the portable subset checker (hack/check_native_format.py) — the
+# same pair CI enforces.
 lint:
 	@if $(PYTHON) -c "import flake8" >/dev/null 2>&1; then \
 		$(PYTHON) -m flake8 llm_d_kv_cache_manager_tpu tests examples \
@@ -22,9 +24,8 @@ lint:
 		clang-format --dry-run --Werror \
 			llm_d_kv_cache_manager_tpu/native/src/*.cpp \
 			llm_d_kv_cache_manager_tpu/native/src/*.hpp; \
-	else \
-		echo "clang-format not installed; skipping native format check"; \
 	fi
+	$(PYTHON) hack/check_native_format.py
 
 test: unit-test
 

@@ -1375,8 +1375,11 @@ def emit_cpu_fallback(device_error: str) -> None:
     routing_samples = measure_routing_micro(
         requests, hashes_list, warmup_idx
     )
-    _progress("fallback: index/tokenization microbenches")
-    micro = bench_micro()
+    if _over_budget(reserve_s=60.0):
+        micro = {"truncated": True}
+    else:
+        _progress("fallback: index/tokenization microbenches")
+        micro = bench_micro()
     _progress("fallback: virtual-clock matrix (calibrated service times)")
     matrix, matrix_truncated = run_matrix(
         requests, hashes_list, t_miss, t_hit, ideal_service, warmup_idx
@@ -1573,9 +1576,14 @@ def main() -> None:
     median = by_speedup[(len(by_speedup) - 1) // 2]
     speedup = median["speedup"]
 
-    # detail.micro: device-free index/tokenization microbenches.
-    _progress("detail.micro: index/tokenization microbenches")
-    micro = bench_micro()
+    # detail.micro: device-free index/tokenization microbenches —
+    # optional like every detail layer: past the budget it is skipped
+    # and marked, per the degrade contract in the module docstring.
+    if _over_budget(reserve_s=60.0):
+        micro = {"truncated": True}
+    else:
+        _progress("detail.micro: index/tokenization microbenches")
+        micro = bench_micro()
 
     # detail.matrix: 5 strategies x QPS ladder x seeds, virtual clock.
     _progress("detail.matrix: virtual-clock strategy ladder")

@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Deterministic native-format gate that runs in ANY environment.
+
+The authoritative gate is ``clang-format --dry-run --Werror`` with the
+pinned root ``.clang-format`` (Google, 80 col) — CI runs it on GitHub
+runners, where the binary ships.  The dev image has no clang-format and
+cannot install one, so this checker enforces the mechanically-decidable
+subset of the same style everywhere a Python interpreter exists:
+
+* UTF-8, LF line endings, final newline present
+* no tab characters, no trailing whitespace
+* <= 80 columns
+* indentation in steps of two spaces (Google IndentWidth: 2), allowing
+  continuation-line alignment (any depth deeper than the previous
+  line's + 2 is treated as alignment and accepted)
+
+A file that passes clang-format also passes this subset; a file that
+fails this subset fails clang-format.  Exit 0 = clean, 1 = violations
+(one line each: path:line: message).
+
+Usage: python hack/check_native_format.py [files...]
+(defaults to llm_d_kv_cache_manager_tpu/native/src/*.cpp|hpp)
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+DEFAULT_GLOBS = (
+    "llm_d_kv_cache_manager_tpu/native/src/*.cpp",
+    "llm_d_kv_cache_manager_tpu/native/src/*.hpp",
+)
+MAX_COLS = 80
+INDENT = 2
+
+
+def check_file(path: str) -> list:
+    problems = []
+    with open(path, "rb") as handle:
+        raw = handle.read()
+    try:
+        text = raw.decode("utf-8")
+    except UnicodeDecodeError as exc:
+        return [f"{path}:0: not valid UTF-8 ({exc})"]
+    if b"\r" in raw:
+        problems.append(f"{path}:0: CR line endings (LF only)")
+    if raw and not raw.endswith(b"\n"):
+        problems.append(f"{path}:0: missing final newline")
+    prev_indent = 0
+    for lineno, line in enumerate(text.split("\n")[:-1], start=1):
+        if "\t" in line:
+            problems.append(f"{path}:{lineno}: tab character")
+        if line != line.rstrip():
+            problems.append(f"{path}:{lineno}: trailing whitespace")
+        if len(line) > MAX_COLS:
+            problems.append(
+                f"{path}:{lineno}: {len(line)} columns (max {MAX_COLS})"
+            )
+        stripped = line.lstrip(" ")
+        if not stripped:
+            continue
+        indent = len(line) - len(stripped)
+        # Google style indents in steps of 2; deeper indents are
+        # continuation alignment (clang-format aligns to arbitrary
+        # columns), so only a *shallow* odd step relative to the
+        # previous code line is decidably wrong.
+        if indent <= prev_indent + INDENT and indent % INDENT:
+            # Exceptions clang-format itself produces at odd columns:
+            # ' *' continuation lines of block comments and visibility
+            # labels (Google offsets 'public:' etc. by one).
+            is_comment_cont = stripped.startswith("*")
+            is_access_label = stripped.rstrip() in (
+                "public:",
+                "private:",
+                "protected:",
+            )
+            if not is_comment_cont and not is_access_label:
+                problems.append(
+                    f"{path}:{lineno}: indent {indent} not a multiple "
+                    f"of {INDENT}"
+                )
+        if indent <= prev_indent + INDENT:
+            prev_indent = indent
+    return problems
+
+
+def main() -> int:
+    files = sys.argv[1:]
+    if not files:
+        root = os.path.join(os.path.dirname(__file__), "..")
+        files = [
+            path
+            for pattern in DEFAULT_GLOBS
+            for path in sorted(glob.glob(os.path.join(root, pattern)))
+        ]
+    if not files:
+        print("check_native_format: no files found", file=sys.stderr)
+        return 1
+    problems = []
+    for path in files:
+        problems.extend(check_file(path))
+    for problem in problems:
+        print(problem)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

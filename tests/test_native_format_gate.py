@@ -1,0 +1,99 @@
+"""The native format gate must be enforcing, not advisory.
+
+The reference hard-gates native code style in CI
+(.github/workflows/ci-pr-checks.yaml:69-89 + hooks/pre-commit.sh).
+This repo enforces the same two ways: real clang-format on runners
+that have it, and hack/check_native_format.py (the mechanically-
+decidable subset of the pinned Google style) everywhere else.  These
+tests pin that (a) the tree is clean under the subset gate, (b) the
+gate actually rejects violations, and (c) CI runs both steps with no
+continue-on-error escape hatch.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "hack", "check_native_format.py")
+
+
+def run_checker(*args):
+    return subprocess.run(
+        [sys.executable, CHECKER, *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+
+
+class TestSubsetGate:
+    def test_tree_is_clean(self):
+        proc = run_checker()
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_rejects_violations(self, tmp_path):
+        bad = tmp_path / "bad.cpp"
+        bad.write_text(
+            "int main() {\n"
+            "\treturn 0;  \n"  # tab + trailing whitespace
+            "  int y;\n"
+            "   int x;\n"  # 3-space indent after a 2-space line
+            "}\n"
+            + "// " + "x" * 90 + "\n"  # >80 cols
+        )
+        proc = run_checker(str(bad))
+        assert proc.returncode == 1
+        out = proc.stdout
+        assert "tab character" in out
+        assert "trailing whitespace" in out
+        assert "columns" in out
+        assert "not a multiple" in out
+
+    def test_rejects_missing_final_newline(self, tmp_path):
+        bad = tmp_path / "bad.hpp"
+        bad.write_text("int x;")
+        proc = run_checker(str(bad))
+        assert proc.returncode == 1
+        assert "final newline" in proc.stdout
+
+    def test_accepts_continuation_alignment(self, tmp_path):
+        good = tmp_path / "good.cpp"
+        good.write_text(
+            "void f(int a,\n"
+            "       int b) {\n"  # clang-format argument alignment
+            "  g(a,\n"
+            "    b);\n"
+            "}\n"
+            "/* block\n"
+            " * comment */\n"
+            "class C {\n"
+            " public:\n"  # Google one-space access label
+            "  int x;\n"
+            "};\n"
+        )
+        proc = run_checker(str(good))
+        assert proc.returncode == 0, proc.stdout
+
+
+class TestCIGateIsHard:
+    def test_no_continue_on_error_on_format_steps(self):
+        """Scoped to the two format steps: an unrelated advisory step
+        elsewhere in CI is allowed to use continue-on-error."""
+        with open(
+            os.path.join(REPO, ".github", "workflows", "ci.yaml")
+        ) as handle:
+            ci = handle.read()
+        steps = ci.split("- name:")
+        format_steps = [
+            s
+            for s in steps
+            if "clang-format --dry-run --Werror" in s
+            or "check_native_format.py" in s
+        ]
+        assert len(format_steps) == 2, (
+            "expected the clang-format step and the portable subset "
+            f"step; found {len(format_steps)}"
+        )
+        for step in format_steps:
+            assert "continue-on-error" not in step

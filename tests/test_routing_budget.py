@@ -1,0 +1,87 @@
+"""Budgets on the scoring RPC: regressions fail a test, not just
+drift in a bench JSON.
+
+Reference counterpart: the index microbenchmark
+(tests/profiling/kv_cache_index/index_benchmark_test.go:97-197)
+measures Add/Lookup at a 10k-key population; the precise scorer's
+end-to-end cost (tokenize -> chained hashes -> lookup -> tier-weighted
+score) is what bench.py reports as ``routing_precise_us``.
+
+Budgets are deliberately regression tripwires, not perf claims: they
+carry ~3x headroom over what this repo's slowest measured host (the
+1-core CI VM: p50 ~2.2 ms, p99 ~2.6 ms at the full 8448-token /
+528-block geometry) produces, so an order-of-magnitude blowup —
+accidental O(n^2) in the prefix walk, a lost early-stop, a per-call
+re-tokenization — fails here, while machine noise does not.  The
+precise numbers live in BENCH_r*.json.
+"""
+
+import random
+import time
+
+import numpy as np
+
+import bench
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    InMemoryIndexConfig,
+    PodEntry,
+)
+
+# End-to-end scoring RPC at full bench geometry (8448-token prompts).
+SCORING_P50_BUDGET_S = 8e-3
+SCORING_P99_BUDGET_S = 15e-3
+
+# Index lookup component at the reference microbench scale.
+LOOKUP_CHAIN_BUDGET_S = 5e-3  # one 528-key chain against 10k keys
+N_KEYS = 10_000
+
+
+class TestScoringRpcBudget:
+    def test_full_geometry_scoring_percentiles(self):
+        requests, warmup, hashes_list = bench.make_workload()
+        samples = bench.measure_routing_micro(
+            requests, hashes_list, warmup
+        )
+        assert len(samples) >= 16
+        p50 = float(np.percentile(samples, 50))
+        p99 = float(np.percentile(samples, 99))
+        assert p50 < SCORING_P50_BUDGET_S, (
+            f"scoring RPC p50 {p50 * 1e3:.2f} ms exceeds "
+            f"{SCORING_P50_BUDGET_S * 1e3:.0f} ms budget"
+        )
+        assert p99 < SCORING_P99_BUDGET_S, (
+            f"scoring RPC p99 {p99 * 1e3:.2f} ms exceeds "
+            f"{SCORING_P99_BUDGET_S * 1e3:.0f} ms budget"
+        )
+
+    def test_index_lookup_component_budget(self):
+        """Lookup of one full-prompt chain against a 10k-key population
+        (the reference microbench's axis) stays inside its budget."""
+        rng = random.Random(5)
+        index = InMemoryIndex(InMemoryIndexConfig(size=N_KEYS * 2))
+        keys = [rng.getrandbits(64) for _ in range(N_KEYS)]
+        entry_lists = [
+            [PodEntry(f"pod-{i}", "hbm")] for i in range(4)
+        ]
+        for i, key in enumerate(keys):
+            index.add([key], [key], entry_lists[i % 4])
+        chain_len = bench.TOTAL_TOKENS // bench.BLOCK_SIZE
+        chains = [
+            keys[offset:offset + chain_len]
+            for offset in range(0, N_KEYS - chain_len, chain_len)
+        ]
+        index.lookup(chains[0], None)  # warm
+        times = []
+        for chain in chains:
+            t0 = time.perf_counter()
+            index.lookup(chain, None)
+            times.append(time.perf_counter() - t0)
+        worst = max(times)
+        assert worst < LOOKUP_CHAIN_BUDGET_S, (
+            f"index lookup {worst * 1e3:.2f} ms per {chain_len}-key "
+            f"chain at {N_KEYS} keys exceeds "
+            f"{LOOKUP_CHAIN_BUDGET_S * 1e3:.0f} ms budget"
+        )
