@@ -1342,6 +1342,16 @@ def bench_micro() -> dict:
     }
 
 
+def maybe_bench_micro(context: str) -> dict:
+    """bench_micro under the degrade contract: skipped + marked past
+    the budget.  One helper for both emit paths so the sentinel shape
+    and reserve stay in lockstep."""
+    if _over_budget(reserve_s=60.0):
+        return {"truncated": True}
+    _progress(f"{context}: index/tokenization microbenches")
+    return bench_micro()
+
+
 def _routing_percentiles(samples: Sequence[float]) -> Optional[dict]:
     if not samples:
         return None
@@ -1375,11 +1385,7 @@ def emit_cpu_fallback(device_error: str) -> None:
     routing_samples = measure_routing_micro(
         requests, hashes_list, warmup_idx
     )
-    if _over_budget(reserve_s=60.0):
-        micro = {"truncated": True}
-    else:
-        _progress("fallback: index/tokenization microbenches")
-        micro = bench_micro()
+    micro = maybe_bench_micro("fallback")
     _progress("fallback: virtual-clock matrix (calibrated service times)")
     matrix, matrix_truncated = run_matrix(
         requests, hashes_list, t_miss, t_hit, ideal_service, warmup_idx
@@ -1577,13 +1583,8 @@ def main() -> None:
     speedup = median["speedup"]
 
     # detail.micro: device-free index/tokenization microbenches —
-    # optional like every detail layer: past the budget it is skipped
-    # and marked, per the degrade contract in the module docstring.
-    if _over_budget(reserve_s=60.0):
-        micro = {"truncated": True}
-    else:
-        _progress("detail.micro: index/tokenization microbenches")
-        micro = bench_micro()
+    # optional like every detail layer per the degrade contract.
+    micro = maybe_bench_micro("detail.micro")
 
     # detail.matrix: 5 strategies x QPS ladder x seeds, virtual clock.
     _progress("detail.matrix: virtual-clock strategy ladder")

@@ -75,6 +75,12 @@ class KVCacheMetrics:
             ("tokenizer",),
             registry=self.registry,
         )
+        self.tokenization_prefix_fast_path = Counter(
+            f"{_NAMESPACE}_tokenization_prefix_fast_path_total",
+            "Tokenizations served from the prefix store (coverage >= "
+            "min_prefix_overlap_ratio) instead of a full tokenizer run.",
+            registry=self.registry,
+        )
         self.kvevents_dropped = Counter(
             f"{_NAMESPACE}_kvevents_dropped_total",
             "KV-event messages dropped by the ingestion pool by reason.",

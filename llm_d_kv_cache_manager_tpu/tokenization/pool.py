@@ -22,6 +22,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import List, Optional
 
+from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
 from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
     ApplyChatTemplateRequest,
     ChatTemplatingProcessor,
@@ -196,6 +197,7 @@ class TokenizationPool:
             )
         )
         if overlap_ratio >= self.config.min_prefix_overlap_ratio:
+            METRICS.tokenization_prefix_fast_path.inc()
             trace(
                 logger,
                 "prefix-store fast path: %d tokens at %.2f coverage",

@@ -40,7 +40,7 @@ class TestSubsetGate:
             "  int y;\n"
             "   int x;\n"  # 3-space indent after a 2-space line
             "}\n"
-            + "// " + "x" * 90 + "\n"  # >80 cols
+            + "// " + "word " * 20 + "\n"  # >80 cols, breakable
         )
         proc = run_checker(str(bad))
         assert proc.returncode == 1
@@ -56,6 +56,20 @@ class TestSubsetGate:
         proc = run_checker(str(bad))
         assert proc.returncode == 1
         assert "final newline" in proc.stdout
+
+    def test_accepts_unbreakable_overflow_and_raw_strings(self, tmp_path):
+        """clang-format leaves a single unbreakable token over the
+        column limit and never edits raw-string contents; the subset
+        gate must not fail code the authoritative gate accepts."""
+        good = tmp_path / "good.hpp"
+        good.write_text(
+            '#include "' + "a/" * 45 + 'long_header.hpp"\n'
+            'const char* kDoc = R"(\n'
+            "\ttab and trailing space inside raw string  \n"
+            ')";\n'
+        )
+        proc = run_checker(str(good))
+        assert proc.returncode == 0, proc.stdout
 
     def test_accepts_continuation_alignment(self, tmp_path):
         good = tmp_path / "good.cpp"

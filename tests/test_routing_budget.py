@@ -42,12 +42,24 @@ N_KEYS = 10_000
 class TestScoringRpcBudget:
     def test_full_geometry_scoring_percentiles(self):
         requests, warmup, hashes_list = bench.make_workload()
-        samples = bench.measure_routing_micro(
-            requests, hashes_list, warmup
-        )
-        assert len(samples) >= 16
-        p50 = float(np.percentile(samples, 50))
-        p99 = float(np.percentile(samples, 99))
+
+        def percentiles():
+            samples = bench.measure_routing_micro(
+                requests, hashes_list, warmup
+            )
+            assert len(samples) >= 16
+            return (
+                float(np.percentile(samples, 50)),
+                float(np.percentile(samples, 99)),
+            )
+
+        p50, p99 = percentiles()
+        if p50 >= SCORING_P50_BUDGET_S or p99 >= SCORING_P99_BUDGET_S:
+            # p99 over ~40 samples is nearly max-of-samples: one OS
+            # scheduling stall on a shared CI runner can blow it.  A
+            # REGRESSION reproduces on a fresh measurement; a stall
+            # does not — retry exactly once before failing.
+            p50, p99 = percentiles()
         assert p50 < SCORING_P50_BUDGET_S, (
             f"scoring RPC p50 {p50 * 1e3:.2f} ms exceeds "
             f"{SCORING_P50_BUDGET_S * 1e3:.0f} ms budget"
@@ -74,12 +86,18 @@ class TestScoringRpcBudget:
             for offset in range(0, N_KEYS - chain_len, chain_len)
         ]
         index.lookup(chains[0], None)  # warm
-        times = []
-        for chain in chains:
-            t0 = time.perf_counter()
-            index.lookup(chain, None)
-            times.append(time.perf_counter() - t0)
-        worst = max(times)
+
+        def worst_lookup():
+            times = []
+            for chain in chains:
+                t0 = time.perf_counter()
+                index.lookup(chain, None)
+                times.append(time.perf_counter() - t0)
+            return max(times)
+
+        worst = worst_lookup()
+        if worst >= LOOKUP_CHAIN_BUDGET_S:
+            worst = worst_lookup()  # stall-vs-regression retry (above)
         assert worst < LOOKUP_CHAIN_BUDGET_S, (
             f"index lookup {worst * 1e3:.2f} ms per {chain_len}-key "
             f"chain at {N_KEYS} keys exceeds "

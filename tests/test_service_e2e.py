@@ -240,6 +240,93 @@ class TestServiceE2E:
         extended = prompt + "how vexingly quick daft zebras jump . " * 50
         assert fleet.score(extended)["pod-long"] <= n_blocks
 
+    def test_long_prompt_full_scenario(self, fleet):
+        """Reference depth (e2e_test.go:214-251) at >280-block chains:
+        prefix expansion, reduction, and mid-prompt divergence through
+        the booted service, with BLOCK-ACCURATE hit-count asserts —
+        each expectation computed from the token stream the service
+        will actually use (full tokenization, or the prefix store's
+        serve when its coverage engages the fast path) — plus the
+        fast path PROVEN engaged via its metrics counter."""
+        from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+        prompt = SENTENCE * 460  # 4600 tokens
+        # NOTE: this helper tokenize populates the prefix store, so
+        # every score below may be served from it; expectations are
+        # computed accordingly (served_blocks_for), never hand-waved.
+        tokens = fleet.tokenize(prompt)
+        assert len(tokens) >= 4500
+        n_blocks = len(tokens) // BLOCK_SIZE
+        assert n_blocks > 280  # the reference's per-request chain scale
+
+        def served_blocks_for(text):
+            """Block count of the token stream the service will score
+            ``text`` with: the prefix-store serve when its coverage
+            engages the fast path, full tokenization otherwise."""
+            pool = fleet.indexer.tokenization_pool
+            served, coverage = (
+                pool._prefix_store.find_longest_contained_tokens(
+                    text, MODEL
+                )
+            )
+            # The LIVE threshold, not a copy of its default: the test
+            # must follow whatever serve path the pool actually takes.
+            if coverage >= pool.config.min_prefix_overlap_ratio:
+                return len(served) // BLOCK_SIZE
+            return len(fleet.tokenize(text)) // BLOCK_SIZE
+
+        # -- expansion: store the first half; the score caps exactly
+        # there regardless of serve path (stored < served length).
+        half = n_blocks // 2 * BLOCK_SIZE
+        first = fleet.publish("pod-long", tokens[:half])
+        assert fleet.score(prompt)["pod-long"] == pytest.approx(
+            half // BLOCK_SIZE
+        )
+        # Store the second half chained on the parent hash: the score
+        # lifts to exactly the served block count (== full tokenization
+        # minus at most one trailing chunk).
+        fleet.publish("pod-long", tokens[half:], parent=first[-1])
+        expected = served_blocks_for(prompt)
+        assert 0.97 * n_blocks <= expected <= n_blocks
+        assert fleet.score(prompt)["pod-long"] == pytest.approx(expected)
+
+        # -- reduction: a one-third prefix of the same prompt hits
+        # exactly its served block count.
+        short = SENTENCE * 150
+        assert fleet.score(short)["pod-long"] == pytest.approx(
+            served_blocks_for(short)
+        )
+
+        # -- mid-prompt divergence: same first half, different tail.
+        # Shared coverage ~0.5 < 0.8 keeps it off the fast path, so
+        # the cap is exactly the shared full blocks.
+        divergent = (
+            SENTENCE * 230
+            + "pack my box with five dozen liquor jugs . " * 230
+        )
+        div_tokens = fleet.tokenize(divergent)
+        shared = 0
+        for a, b in zip(tokens, div_tokens):
+            if a != b:
+                break
+            shared += 1
+        assert shared >= 2000  # genuinely long shared prefix
+        assert fleet.score(divergent)["pod-long"] == pytest.approx(
+            shared // BLOCK_SIZE
+        )
+
+        # -- fast path PROVEN engaged (counter, not assumption) on a
+        # full-prompt re-score.
+        def fast_path_count():
+            counter = METRICS.tokenization_prefix_fast_path
+            return counter.collect()[0].samples[0].value
+
+        before = fast_path_count()
+        rescore = fleet.score(prompt)["pod-long"]
+        after = fast_path_count()
+        assert after > before, "prefix-store fast path never engaged"
+        assert rescore == pytest.approx(expected)
+
     def test_chat_completions_e2e(self, fleet):
         """e2e_test.go:254 TestChatCompletionsE2E through the service."""
         messages = [
