@@ -863,6 +863,75 @@ def bench_kernels(readback_rtt: float) -> dict:
         "pallas" if t_decode_pallas <= t_decode_xla else "gather"
     )
 
+    # detail.kernels.ring: per-ring-step cost, einsum body vs the
+    # mask-aware flash partial (ops/ring_flash_pallas.py).  A single
+    # chip cannot run a real multi-device ring, but the ring's
+    # wall-clock is R x (per-step body + overlapped permute), so the
+    # step bodies ARE the comparison: the striped layout's win is
+    # exactly flash_causal_step vs einsum_step on every device at
+    # every step.
+    from llm_d_kv_cache_manager_tpu.ops.ring_flash_pallas import (
+        flash_partial,
+        normalize_partial,
+    )
+
+    RING = 4  # a 4-chip pod-slice ring over the 8k prefill
+    T_local = PREFIX_TOKENS // RING
+    qr = jax.random.normal(k2, (1, T_local, H, Dh), jnp.bfloat16)
+    kr = jax.random.normal(k3, (1, T_local, Hkv, Dh), jnp.bfloat16)
+    vr = jax.random.normal(k1, (1, T_local, Hkv, Dh), jnp.bfloat16)
+
+    def einsum_step(qq):
+        """One ring step in the einsum body (diagonal/causal step):
+        full Tq x Tk product + where() mask + softmax + output — what
+        _ring_attention_local pays per step regardless of the mask."""
+        groups = H // Hkv
+        qf = qq.astype(jnp.float32).reshape(
+            1, T_local, Hkv, groups, Dh
+        ) * (Dh**-0.5)
+        scores = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qf, kr.astype(jnp.float32)
+        )
+        mask = (
+            jnp.arange(T_local)[None, :]
+            <= jnp.arange(T_local)[:, None]
+        )
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+        p = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+        p = p / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-20)
+        out = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", p, vr.astype(jnp.float32)
+        )
+        return out.reshape(1, T_local, H, Dh).astype(qq.dtype)
+
+    # Equality gate first: the flash causal partial must agree with
+    # the einsum body's softmax before its time may count.
+    acc, _, l = flash_partial(qr, kr, vr, causal_offset=0)
+    ring_err = max_rel_err(
+        normalize_partial(acc, l, qr.dtype), einsum_step(qr)
+    )
+    assert ring_err < 0.05, (
+        f"ring flash partial diverges from einsum body: {ring_err:.4f}"
+    )
+
+    t_ring_einsum = time_chained(einsum_step, qr, readback_rtt, steps=8)
+    t_ring_flash_causal = time_chained(
+        lambda qq: flash_partial(qq, kr, vr, causal_offset=0)[0].astype(
+            qq.dtype
+        ),
+        qr,
+        readback_rtt,
+        steps=8,
+    )
+    t_ring_flash_full = time_chained(
+        lambda qq: flash_partial(
+            qq, kr, vr, causal_offset=None
+        )[0].astype(qq.dtype),
+        qr,
+        readback_rtt,
+        steps=8,
+    )
+
     Tq = PREFIX_TOKENS  # the 8k shared-prefix prefill shape
     qp = jax.random.normal(k3, (1, Tq, H, Dh), jnp.bfloat16)
     kp = jax.random.normal(k1, (1, Tq, Hkv, Dh), jnp.bfloat16)
@@ -900,6 +969,25 @@ def bench_kernels(readback_rtt: float) -> dict:
             "xla_scan_ms": round(t_flash_xla * 1e3, 2),
             "speedup_pallas": round(t_flash_xla / t_flash_pallas, 2),
             "max_rel_err": round(flash_err, 5),
+        },
+        "ring": {
+            # Ring wall-clock ~= R x per-step body (permutes overlap),
+            # so the step bodies carry the comparison: a striped flash
+            # ring costs ~R x causal_step on every device; the einsum
+            # ring costs ~R x einsum_step.
+            "shape": (
+                f"ring={RING} T_local={T_local} H={H} "
+                f"Hkv={Hkv} D={Dh}"
+            ),
+            "einsum_step_ms": round(t_ring_einsum * 1e3, 2),
+            "flash_causal_step_ms": round(
+                t_ring_flash_causal * 1e3, 2
+            ),
+            "flash_full_step_ms": round(t_ring_flash_full * 1e3, 2),
+            "striped_flash_vs_einsum": round(
+                t_ring_einsum / t_ring_flash_causal, 2
+            ),
+            "max_rel_err": round(ring_err, 5),
         },
     }
 

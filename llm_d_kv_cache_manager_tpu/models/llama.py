@@ -39,6 +39,8 @@ from llm_d_kv_cache_manager_tpu.ops.paged_decode_pallas import (
 from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
 from llm_d_kv_cache_manager_tpu.ops.ring_attention import (
     ring_attention_sharded,
+    stripe,
+    unstripe,
 )
 
 Params = Dict[str, Any]
@@ -225,6 +227,9 @@ def forward(
     positions: Optional[jnp.ndarray] = None,
     use_flash: bool = True,
     sp_mesh=None,
+    ring_striped: bool = False,
+    ring_impl: str = "einsum",
+    ring_interpret: bool = False,
 ) -> jnp.ndarray:
     """Dense forward: tokens [B, T] -> logits [B, T, V].
 
@@ -236,6 +241,16 @@ def forward(
     ring's causal mask derives from each chunk's ring position, i.e.
     global positions 0..T-1 — custom ``positions`` are rejected rather
     than silently mismasked.
+
+    ``ring_striped``: run the whole network in the striped (token-
+    interleaved) sequence layout — tokens AND positions are striped at
+    entry, every layer computes in stripe order (norms/MLP/logits are
+    position-independent; RoPE gets the striped physical positions),
+    attention runs the balanced striped ring, and the logits are
+    unstriped at exit, so the returned contract is unchanged.
+    ``ring_impl="flash"`` routes each ring step through the mask-aware
+    Pallas partial that skips masked sub-tiles — with ``ring_striped``
+    that halves per-step MXU work (ops/ring_flash_pallas.py).
     """
     B, T = tokens.shape
     if sp_mesh is not None and positions is not None:
@@ -246,8 +261,8 @@ def forward(
         )
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T), (B, T))
-    x = jnp.take(params["embed"], tokens, axis=0)
     ring = None
+    striped = False
     if sp_mesh is not None:
 
         def axis_if_used(name):
@@ -258,6 +273,13 @@ def forward(
                 else None
             )
 
+        striped = ring_striped and sp_mesh.shape["sp"] > 1
+        if striped:
+            ring_size = sp_mesh.shape["sp"]
+            tokens = stripe(tokens, ring_size)
+            # Positions stay PHYSICAL (RoPE rotates by true token
+            # index); only their order is striped to match the tokens.
+            positions = stripe(positions, ring_size)
         # Heads ride their tp sharding into the ring (q/k/v come out of
         # tp-sharded wq/wk/wv head-sharded); declaring them replicated
         # would all-gather them across tp every layer.
@@ -265,7 +287,11 @@ def forward(
             sp_mesh,
             batch_axis=axis_if_used("dp"),
             head_axis=axis_if_used("tp"),
+            striped=striped,
+            impl=ring_impl,
+            interpret=ring_interpret,
         )
+    x = jnp.take(params["embed"], tokens, axis=0)
 
     def layer(x, lp):
         h = _rms_norm(x, lp["ln1"])
@@ -279,6 +305,8 @@ def forward(
         return x, None
 
     x, _ = lax.scan(layer, x, params["layers"])
+    if striped:
+        x = unstripe(x, sp_mesh.shape["sp"])
     return _logits(x, params)
 
 

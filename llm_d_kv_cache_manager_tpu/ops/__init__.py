@@ -4,8 +4,10 @@
 - ``ring_attention``: sequence-parallel blockwise attention over an
   ``sp`` mesh axis (ppermute ring over ICI) for long-context prefill;
   ``striped=True`` + ``stripe``/``unstripe`` select the interleaved
-  layout whose causal masks balance across ring steps (the foundation
-  for a mask-aware kernel; see the module docstring's scoping note).
+  layout whose causal masks balance across ring steps, and
+  ``impl="flash"`` runs each step through the mask-aware Pallas
+  partial (ring_flash_pallas.py) that skips masked sub-tiles — with
+  striping, ~half the per-step MXU work.
 - ``paged_attention``: decode-time attention over the paged KV pool
   (block-table gather), the TPU analogue of vLLM's paged attention.
 """

@@ -13,18 +13,26 @@ also *compute* in chunks, across chips.  Use under ``shard_map`` with
 q/k/v sharded on the sequence axis, or via ``ring_attention`` which
 wraps the shard_map given a mesh.
 
-Known performance note: contiguous chunking under causal masking is
+Performance note: contiguous chunking under causal masking is
 load-imbalanced — device 0's queries are fully masked after one step
 while the last device's stay visible every step.  ``striped=True``
 selects the rebalanced layout (tokens interleave across devices via
 ``stripe``/``unstripe``; the causal mask becomes a near-uniform band
-per step).  Scope honestly: the CURRENT body computes the full
-Tq x Tk einsum and masks with where() in both layouts, so neither
-realizes FLOP savings yet — the striped layout is the foundation (its
-masks and exactness are pinned by tests) for a mask-aware inner
-kernel (Pallas sub-block skipping) where the balance converts into
-wall-clock.  The model's ``forward(sp_mesh=...)`` keeps the
-contiguous ring (simpler block tables, exactness-tested).
+per step).  Two step bodies exist:
+
+* ``impl="einsum"`` (portable default): full Tq x Tk product +
+  where() mask — balanced under striping but no FLOPs saved;
+* ``impl="flash"``: each step runs the mask-aware Pallas partial
+  (ops/ring_flash_pallas.py) whose K/V trip count stops at the causal
+  diagonal, merged across steps by the flash-decoding combine.  With
+  ``striped=True`` every step is a near-uniform causal band, so the
+  layout's balance becomes ~half the per-step MXU work on every
+  device; measured per-step on the chip by bench.py (detail.
+  kernels.ring).
+
+The model reaches both: ``forward(sp_mesh=..., ring_striped=True,
+ring_impl="flash")`` runs the whole network in stripe order and
+unstripes before the logits.
 """
 
 from __future__ import annotations
@@ -40,6 +48,34 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
+
+
+def _ring_driver(state, k, v, axis_name: str, accumulate):
+    """Ring skeleton shared by both step bodies: K/V rotate around the
+    ``axis_name`` ring via ppermute while ``accumulate(state, src,
+    k_cur, v_cur)`` folds each chunk in; the last chunk accumulates
+    outside the loop (no wasted final ppermute).  Keeping ONE driver
+    means an overlap/permute change cannot silently apply to one body
+    and not the other."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(i, carry):
+        state, k_cur, v_cur = carry
+        src = (my_idx - i) % axis_size  # ring position k_cur came from
+        state = accumulate(state, src, k_cur, v_cur)
+        return (
+            state,
+            lax.ppermute(k_cur, axis_name, perm),
+            lax.ppermute(v_cur, axis_name, perm),
+        )
+
+    state, k_last, v_last = lax.fori_loop(
+        0, axis_size - 1, step, (state, k, v)
+    )
+    src_last = (my_idx - (axis_size - 1)) % axis_size
+    return accumulate(state, src_last, k_last, v_last)
 
 
 def _ring_attention_local(
@@ -75,10 +111,8 @@ def _ring_attention_local(
     m = zero + NEG_INF
     l = zero
 
-    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
-
-    def accumulate(i, o, m, l, k_cur, v_cur):
-        src = (my_idx - i) % axis_size  # ring position k_cur came from
+    def accumulate(state, src, k_cur, v_cur):
+        o, m, l = state
 
         scores = jnp.einsum(
             "bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32)
@@ -110,21 +144,94 @@ def _ring_attention_local(
         )
         return o, m_new, l
 
-    def step(i, carry):
-        o, m, l, k_cur, v_cur = carry
-        o, m, l = accumulate(i, o, m, l, k_cur, v_cur)
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
-        return o, m, l, k_next, v_next
-
-    # Last chunk accumulates outside the loop: no wasted final ppermute.
-    o, m, l, k_last, v_last = lax.fori_loop(
-        0, axis_size - 1, step, (o, m, l, k, v)
-    )
-    o, m, l = accumulate(axis_size - 1, o, m, l, k_last, v_last)
+    o, m, l = _ring_driver((o, m, l), k, v, axis_name, accumulate)
     l = jnp.maximum(l, 1e-20)
     o = o / l.transpose(0, 3, 1, 2)[..., None]
     return o.reshape(B, Tq, H, D).astype(q.dtype)
+
+
+def _ring_attention_local_flash(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    striped: bool = False,
+    q_block: int = 256,
+    kv_chunk: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Mask-aware per-device body: each ring step runs the Pallas
+    flash PARTIAL (ops/ring_flash_pallas.py) whose K/V trip count
+    stops at the causal diagonal, so masked sub-tiles are never
+    computed — where the einsum body spends a full Tq x Tk product
+    per step and discards the masked half with where().
+
+    Per-step work:
+
+    * striped — every step is a near-uniform causal band (offset 0 or
+      -1): every device does ~half the product at every step. This is
+      where the striped layout's balance becomes FLOPs saved.
+    * contiguous — steps are fully-visible (full product), diagonal
+      (causal half), or fully-masked (skipped outright); per-step
+      wall-clock is still set by the busiest device, which is why the
+      striped layout is the one that converts balance into time.
+
+    GQA note: the partial kernel indexes K/V heads by q_head //
+    (H // Hkv), so q/k/v arrive exactly as _qkv produces them.
+    """
+    from llm_d_kv_cache_manager_tpu.ops.ring_flash_pallas import (
+        flash_partial,
+        merge_partials,
+        neutral_partial,
+        normalize_partial,
+    )
+
+    my_idx = lax.axis_index(axis_name)
+
+    partial_kw = dict(
+        q_block=q_block, kv_chunk=kv_chunk, interpret=interpret
+    )
+
+    def step_partial(src, k_cur, v_cur):
+        operand = (q, k_cur, v_cur)
+        if striped:
+            # Keys from behind me in the ring sit one global position
+            # later at equal local rows: offset -1.
+            return lax.cond(
+                src > my_idx,
+                lambda a: flash_partial(
+                    *a, causal_offset=-1, **partial_kw
+                ),
+                lambda a: flash_partial(
+                    *a, causal_offset=0, **partial_kw
+                ),
+                operand,
+            )
+        # Contiguous: 0 = fully visible, 1 = diagonal, 2 = fully masked.
+        case = (src >= my_idx).astype(jnp.int32) + (
+            src > my_idx
+        ).astype(jnp.int32)
+        return lax.switch(
+            case,
+            [
+                lambda a: flash_partial(
+                    *a, causal_offset=None, **partial_kw
+                ),
+                lambda a: flash_partial(
+                    *a, causal_offset=0, **partial_kw
+                ),
+                lambda a: neutral_partial(a[0]),
+            ],
+            operand,
+        )
+
+    def accumulate(state, src, k_cur, v_cur):
+        return merge_partials(state, step_partial(src, k_cur, v_cur))
+
+    acc, _, l = _ring_driver(
+        neutral_partial(q), k, v, axis_name, accumulate
+    )
+    return normalize_partial(acc, l, q.dtype)
 
 
 def stripe(x: jnp.ndarray, ring_size: int, axis: int = 1) -> jnp.ndarray:
@@ -155,6 +262,8 @@ def ring_attention_sharded(
     batch_axis: Optional[str] = "dp",
     head_axis: Optional[str] = None,
     striped: bool = False,
+    impl: str = "einsum",
+    interpret: bool = False,
 ):
     """The in-jit form: returns a callable ``(q, k, v) -> out`` over
     already-sharded [B, T, H(kv), D] arrays (T over ``axis_name``, B
@@ -173,17 +282,41 @@ def ring_attention_sharded(
     work balances across ring steps instead of concentrating on the
     last chunks.  RoPE/position embeddings must be applied BEFORE
     striping (or with striped position vectors) — positions are
-    physical token indices, not stripe slots."""
+    physical token indices, not stripe slots.
+
+    ``impl``: ``"einsum"`` is the portable full-product body;
+    ``"flash"`` runs each step through the mask-aware Pallas partial
+    (_ring_attention_local_flash) that skips masked sub-tiles — with
+    ``striped=True`` this halves per-step MXU work.  ``interpret``
+    runs the Pallas kernel in interpret mode (CPU tests)."""
     bspec = batch_axis if batch_axis else None
     spec = P(bspec, axis_name, head_axis, None)
-    local = functools.partial(
-        _ring_attention_local, axis_name=axis_name, striped=striped
-    )
+    extra = {}
+    if impl == "flash":
+        local = functools.partial(
+            _ring_attention_local_flash,
+            axis_name=axis_name,
+            striped=striped,
+            interpret=interpret,
+        )
+        # Pallas calls inside shard_map trip the vma checker (its
+        # interpreter's internal slices don't pvary index operands);
+        # JAX's own error message prescribes check_vma=False.  Ring
+        # exactness is pinned by tests/test_llama_model.py
+        # (test_flash_ring_matches_dense_both_layouts) instead.
+        extra["check_vma"] = False
+    elif impl == "einsum":
+        local = functools.partial(
+            _ring_attention_local, axis_name=axis_name, striped=striped
+        )
+    else:
+        raise ValueError(f"unknown ring impl {impl!r}")
     return jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **extra,
     )
 
 
@@ -195,6 +328,8 @@ def ring_attention(
     axis_name: str = "sp",
     batch_axis: Optional[str] = "dp",
     striped: bool = False,
+    impl: str = "einsum",
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Eager convenience: place q/k/v ([B, T, H, D]; T sharded over
     ``axis_name``, B over ``batch_axis``) and run the ring.
@@ -210,7 +345,12 @@ def ring_attention(
     bspec = batch_axis if batch_axis else None
     spec = P(bspec, axis_name, None, None)
     fn = ring_attention_sharded(
-        mesh, axis_name, batch_axis, striped=striped
+        mesh,
+        axis_name,
+        batch_axis,
+        striped=striped,
+        impl=impl,
+        interpret=interpret,
     )
     q = jax.device_put(q, NamedSharding(mesh, spec))
     k = jax.device_put(k, NamedSharding(mesh, spec))
