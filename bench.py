@@ -719,6 +719,11 @@ def run_fleet(
 
 TIMING_CHAIN_STEPS = 24
 
+# The Pallas decode kernel is routed over the XLA gather only when it
+# wins by at least this factor at every measured serving shape — a
+# within-noise margin (r4: 1.09x) must not flip the default.
+DECODE_ROUTE_MIN_SPEEDUP = 1.3
+
 
 def time_chained(op, operand, readback_rtt: float = 0.0,
                  steps: int = TIMING_CHAIN_STEPS) -> float:
@@ -763,6 +768,9 @@ def bench_kernels(readback_rtt: float) -> dict:
     if jax.default_backend() != "tpu":
         return {"skipped": f"backend={jax.default_backend()}"}
     from llm_d_kv_cache_manager_tpu.ops import flash_pallas
+    from llm_d_kv_cache_manager_tpu.ops.attention import (
+        causal_gqa_attention,
+    )
     from llm_d_kv_cache_manager_tpu.ops.flash_attention import (
         flash_gqa_attention,
     )
@@ -774,31 +782,36 @@ def bench_kernels(readback_rtt: float) -> dict:
     )
 
     H, Hkv, Dh = CFG.n_heads, CFG.n_kv_heads, CFG.head_dim
-    B = 4  # concurrent decode batch at the fleet's serving shape
     nblocks = TOTAL_TOKENS // BLOCK_SIZE
     k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
     kv_layer = jax.random.normal(
         k1, (POOL_BLOCKS, 2, BLOCK_SIZE, Hkv, Dh), jnp.bfloat16
     )
-    q = jax.random.normal(k2, (B, H, Dh), jnp.bfloat16)
-    table = jnp.asarray(
-        np.stack(
-            [
-                np.random.RandomState(7 + i).permutation(POOL_BLOCKS)[
-                    :nblocks
-                ]
-                for i in range(B)
-            ]
-        ),
-        jnp.int32,
-    )
-    ctx = jnp.full((B,), TOTAL_TOKENS, jnp.int32)
 
-    xla_out = paged_attention(q, kv_layer, table, ctx)
+    def decode_operands(B):
+        q = jax.random.normal(k2, (B, H, Dh), jnp.bfloat16)
+        table = jnp.asarray(
+            np.stack(
+                [
+                    np.random.RandomState(7 + i).permutation(
+                        POOL_BLOCKS
+                    )[:nblocks]
+                    for i in range(B)
+                ]
+            ),
+            jnp.int32,
+        )
+        ctx = jnp.full((B,), TOTAL_TOKENS, jnp.int32)
+        return q, table, ctx
+
     # Decode is sub-ms per call: long chains lift the measurement well
     # above the tunnel's RTT jitter.  Sweep the kernel's blocks-per-
-    # step tile (r3 review: BLOCKS_PER_STEP=4 was tuned by anecdote);
-    # every candidate must pass the equality gate before it may win.
+    # step tile at the primary shape (r3 review: BLOCKS_PER_STEP=4 was
+    # tuned by anecdote); every candidate must pass the equality gate
+    # before it may win.
+    B_PRIMARY, B_WIDE = 4, 16  # the fleet's and a loaded serving batch
+    q, table, ctx = decode_operands(B_PRIMARY)
+    xla_out = paged_attention(q, kv_layer, table, ctx)
     sweep = {}
     best_p, t_decode_pallas, decode_err = None, float("inf"), 1.0
     for blocks_per_step in (2, 4, 8):
@@ -830,6 +843,7 @@ def bench_kernels(readback_rtt: float) -> dict:
     # sweep), never a bench abort — unlike the P-sweep asserts above,
     # which gate the default kernel's correctness.
     mxu_native = False
+    t_pallas_f32, err_f32 = t_decode_pallas, decode_err
     err = max_rel_err(
         paged_decode_attention_pallas(
             q, kv_layer, table, ctx,
@@ -858,9 +872,67 @@ def bench_kernels(readback_rtt: float) -> dict:
         readback_rtt,
         steps=96,
     )
-    # "gather" is LlamaConfig.decode_attention's name for the XLA path.
+
+    # Second serving shape: the B=4 winner config re-measured at a
+    # loaded batch, so the routing decision holds across shapes
+    # instead of being a one-point anecdote.
+    q_w, table_w, ctx_w = decode_operands(B_WIDE)
+    xla_out_w = paged_attention(q_w, kv_layer, table_w, ctx_w)
+    err_w = max_rel_err(
+        paged_decode_attention_pallas(
+            q_w, kv_layer, table_w, ctx_w,
+            blocks_per_step=best_p, mxu_native=mxu_native,
+        ),
+        xla_out_w,
+    )
+    if mxu_native and err_w >= 0.05:
+        # The optional bf16-operand variant must hold at EVERY shape;
+        # diverging here demotes it (ineligible, never a bench abort —
+        # same policy as the primary-shape gate) and reverts the
+        # primary timing to the f32-upcast winner.
+        sweep[f"P{best_p}_bf16_wide"] = (
+            f"ineligible at B={B_WIDE}: rel err {err_w:.4f}"
+        )
+        mxu_native = False
+        t_decode_pallas, decode_err = t_pallas_f32, err_f32
+        err_w = max_rel_err(
+            paged_decode_attention_pallas(
+                q_w, kv_layer, table_w, ctx_w,
+                blocks_per_step=best_p, mxu_native=False,
+            ),
+            xla_out_w,
+        )
+    assert err_w < 0.05, (
+        f"paged-decode Pallas diverges at B={B_WIDE}: {err_w:.4f}"
+    )
+    t_pallas_w = time_chained(
+        lambda qq: paged_decode_attention_pallas(
+            qq, kv_layer, table_w, ctx_w,
+            blocks_per_step=best_p, mxu_native=mxu_native,
+        ),
+        q_w,
+        readback_rtt,
+        steps=96,
+    )
+    t_xla_w = time_chained(
+        lambda qq: paged_attention(qq, kv_layer, table_w, ctx_w),
+        q_w,
+        readback_rtt,
+        steps=96,
+    )
+
+    # Routing rule (r4 verdict: a 1.09x margin is within noise of not
+    # mattering): the Pallas kernel is routed only when it beats the
+    # XLA gather by >= DECODE_ROUTE_MIN_SPEEDUP at EVERY measured
+    # serving shape; otherwise the gather is the honest default.
+    speedups = (
+        t_decode_xla / t_decode_pallas,
+        t_xla_w / t_pallas_w,
+    )
     decode_winner = (
-        "pallas" if t_decode_pallas <= t_decode_xla else "gather"
+        "pallas"
+        if min(speedups) >= DECODE_ROUTE_MIN_SPEEDUP
+        else "gather"
     )
 
     # detail.kernels.ring: per-ring-step cost, einsum body vs the
@@ -883,26 +955,11 @@ def bench_kernels(readback_rtt: float) -> dict:
 
     def einsum_step(qq):
         """One ring step in the einsum body (diagonal/causal step):
-        full Tq x Tk product + where() mask + softmax + output — what
-        _ring_attention_local pays per step regardless of the mask."""
-        groups = H // Hkv
-        qf = qq.astype(jnp.float32).reshape(
-            1, T_local, Hkv, groups, Dh
-        ) * (Dh**-0.5)
-        scores = jnp.einsum(
-            "bqhgd,bkhd->bhgqk", qf, kr.astype(jnp.float32)
-        )
-        mask = (
-            jnp.arange(T_local)[None, :]
-            <= jnp.arange(T_local)[:, None]
-        )
-        scores = jnp.where(mask[None, None, None], scores, -1e30)
-        p = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
-        p = p / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-20)
-        out = jnp.einsum(
-            "bhgqk,bkhd->bqhgd", p, vr.astype(jnp.float32)
-        )
-        return out.reshape(1, T_local, H, Dh).astype(qq.dtype)
+        the dense op the ring's where()-masked einsum path pays per
+        step regardless of the mask (ops/attention.py — the SAME math
+        _ring_attention_local inlines, via the shared helper so the
+        reference cannot drift)."""
+        return causal_gqa_attention(qq, kr, vr)
 
     # Equality gate first: the flash causal partial must agree with
     # the einsum body's softmax before its time may count.
@@ -953,12 +1010,20 @@ def bench_kernels(readback_rtt: float) -> dict:
     )
     return {
         "paged_decode": {
-            "shape": f"B={B} ctx={TOTAL_TOKENS} blocks={nblocks}",
+            "shape": f"B={B_PRIMARY} ctx={TOTAL_TOKENS} blocks={nblocks}",
             "pallas_us": round(t_decode_pallas * 1e6, 1),
             "xla_gather_us": round(t_decode_xla * 1e6, 1),
             "speedup_pallas": round(t_decode_xla / t_decode_pallas, 2),
+            "wide_shape": f"B={B_WIDE} ctx={TOTAL_TOKENS}",
+            "wide_pallas_us": round(t_pallas_w * 1e6, 1),
+            "wide_xla_gather_us": round(t_xla_w * 1e6, 1),
+            "wide_speedup_pallas": round(t_xla_w / t_pallas_w, 2),
             "max_rel_err": round(decode_err, 5),
             "winner": decode_winner,
+            "route_rule": (
+                f"pallas iff speedup >= {DECODE_ROUTE_MIN_SPEEDUP} at "
+                "every measured shape"
+            ),
             "blocks_per_step_sweep": sweep,
             "blocks_per_step": best_p,
             "mxu_native": mxu_native,
