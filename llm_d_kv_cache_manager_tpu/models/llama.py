@@ -228,7 +228,7 @@ def forward(
     use_flash: bool = True,
     sp_mesh=None,
     ring_striped: bool = False,
-    ring_impl: str = "einsum",
+    ring_impl: str = "auto",
     ring_interpret: bool = False,
 ) -> jnp.ndarray:
     """Dense forward: tokens [B, T] -> logits [B, T, V].
@@ -248,9 +248,10 @@ def forward(
     position-independent; RoPE gets the striped physical positions),
     attention runs the balanced striped ring, and the logits are
     unstriped at exit, so the returned contract is unchanged.
-    ``ring_impl="flash"`` routes each ring step through the mask-aware
+    ``ring_impl`` defaults to ``"auto"`` (the flash body on TPU, the
+    portable einsum body elsewhere); ``"flash"`` forces the mask-aware
     Pallas partial that skips masked sub-tiles — with ``ring_striped``
-    that halves per-step MXU work (ops/ring_flash_pallas.py).
+    it halves per-step MXU work (ops/ring_flash_pallas.py).
     """
     B, T = tokens.shape
     if sp_mesh is not None and positions is not None:

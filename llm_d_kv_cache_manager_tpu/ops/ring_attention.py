@@ -284,13 +284,22 @@ def ring_attention_sharded(
     striping (or with striped position vectors) — positions are
     physical token indices, not stripe slots.
 
-    ``impl``: ``"einsum"`` is the portable full-product body;
+    ``impl``: ``"auto"`` picks ``"flash"`` on TPU and ``"einsum"``
+    elsewhere; ``"einsum"`` is the portable full-product body;
     ``"flash"`` runs each step through the mask-aware Pallas partial
     (_ring_attention_local_flash) that skips masked sub-tiles — with
     ``striped=True`` this halves per-step MXU work.  ``interpret``
     runs the Pallas kernel in interpret mode (CPU tests)."""
     bspec = batch_axis if batch_axis else None
     spec = P(bspec, axis_name, head_axis, None)
+    if impl == "auto":
+        # The mask-aware Pallas body where the kernel will actually
+        # run (the MESH's platform — a CPU debug mesh on a TPU host
+        # must not dispatch pltpu onto CPU devices); the portable
+        # einsum body elsewhere (interpret-mode Pallas is orders
+        # slower than XLA on CPU).
+        mesh_platform = next(iter(mesh.devices.flat)).platform
+        impl = "flash" if mesh_platform == "tpu" else "einsum"
     extra = {}
     if impl == "flash":
         local = functools.partial(
