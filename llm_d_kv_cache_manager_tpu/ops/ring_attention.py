@@ -20,8 +20,9 @@ selects the rebalanced layout (tokens interleave across devices via
 ``stripe``/``unstripe``; the causal mask becomes a near-uniform band
 per step).  Two step bodies exist:
 
-* ``impl="einsum"`` (portable default): full Tq x Tk product +
-  where() mask — balanced under striping but no FLOPs saved;
+* ``impl="einsum"`` (the portable body; what ``"auto"`` picks off
+  TPU): full Tq x Tk product + where() mask — balanced under striping
+  but no FLOPs saved;
 * ``impl="flash"``: each step runs the mask-aware Pallas partial
   (ops/ring_flash_pallas.py) whose K/V trip count stops at the causal
   diagonal, merged across steps by the flash-decoding combine.  With
@@ -262,7 +263,7 @@ def ring_attention_sharded(
     batch_axis: Optional[str] = "dp",
     head_axis: Optional[str] = None,
     striped: bool = False,
-    impl: str = "einsum",
+    impl: str = "auto",
     interpret: bool = False,
 ):
     """The in-jit form: returns a callable ``(q, k, v) -> out`` over
@@ -297,9 +298,16 @@ def ring_attention_sharded(
         # run (the MESH's platform — a CPU debug mesh on a TPU host
         # must not dispatch pltpu onto CPU devices); the portable
         # einsum body elsewhere (interpret-mode Pallas is orders
-        # slower than XLA on CPU).
+        # slower than XLA on CPU).  interpret=True is an explicit
+        # request to exercise the Pallas kernel, so it forces flash —
+        # silently resolving to einsum would drop the flag and fake
+        # the coverage the caller asked for.
         mesh_platform = next(iter(mesh.devices.flat)).platform
-        impl = "flash" if mesh_platform == "tpu" else "einsum"
+        impl = (
+            "flash"
+            if interpret or mesh_platform == "tpu"
+            else "einsum"
+        )
     extra = {}
     if impl == "flash":
         local = functools.partial(
@@ -337,7 +345,7 @@ def ring_attention(
     axis_name: str = "sp",
     batch_axis: Optional[str] = "dp",
     striped: bool = False,
-    impl: str = "einsum",
+    impl: str = "auto",
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Eager convenience: place q/k/v ([B, T, H, D]; T sharded over
