@@ -386,6 +386,47 @@ def test_forward_sp_tp_mesh_matches_dense(params):
     )
 
 
+def test_forward_sp_tp_mesh_flash_striped_matches_dense(params):
+    """The tp x sp composition must hold for the mask-aware flash body
+    too: per head-shard the partial kernel sees H/tp q-heads and
+    Hkv/tp kv-heads (GQA group count preserved), the striped layout
+    rides the same sp sharding, and the result still matches dense."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(MeshPlan(dp=1, tp=2, sp=2), jax.devices()[:4])
+    pspecs = llama.param_pspecs(CFG)
+    sharded = jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(10), (2, 32), 0, CFG.vocab_size
+    )
+    tokens_sharded = jax.device_put(
+        tokens, NamedSharding(mesh, P(None, "sp"))
+    )
+    dense = llama.forward(params, tokens, CFG)
+    for striped in (False, True):
+        ring_logits = jax.jit(
+            lambda p, t, s=striped: llama.forward(
+                p,
+                t,
+                CFG,
+                sp_mesh=mesh,
+                ring_striped=s,
+                ring_impl="flash",
+                ring_interpret=True,
+            )
+        )(sharded, tokens_sharded)
+        np.testing.assert_allclose(
+            np.asarray(ring_logits),
+            np.asarray(dense),
+            rtol=2e-4,
+            atol=2e-4,
+            err_msg=f"striped={striped}",
+        )
+
+
 def test_sharded_train_step_params_stay_finite(params):
     """Regression: under combined sp x tp sharding, the old
     slice-to-[B, T-1] loss made XLA pad the short sequence shard and
