@@ -38,7 +38,7 @@ from llm_d_kv_cache_manager_tpu.ops.paged_decode_pallas import (
 )
 from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
 from llm_d_kv_cache_manager_tpu.ops.ring_attention import (
-    ring_attention_sharded,
+    ring_for_mesh,
     stripe,
     unstripe,
 )
@@ -269,15 +269,6 @@ def forward(
     ring = None
     striped = False
     if sp_mesh is not None:
-
-        def axis_if_used(name):
-            return (
-                name
-                if name in sp_mesh.axis_names
-                and sp_mesh.shape[name] > 1
-                else None
-            )
-
         striped = ring_striped and sp_mesh.shape["sp"] > 1
         if striped:
             ring_size = sp_mesh.shape["sp"]
@@ -285,13 +276,8 @@ def forward(
             # Positions stay PHYSICAL (RoPE rotates by true token
             # index); only their order is striped to match the tokens.
             positions = stripe(positions, ring_size)
-        # Heads ride their tp sharding into the ring (q/k/v come out of
-        # tp-sharded wq/wk/wv head-sharded); declaring them replicated
-        # would all-gather them across tp every layer.
-        ring = ring_attention_sharded(
+        ring = ring_for_mesh(
             sp_mesh,
-            batch_axis=axis_if_used("dp"),
-            head_axis=axis_if_used("tp"),
             striped=striped,
             impl=ring_impl,
             interpret=ring_interpret,

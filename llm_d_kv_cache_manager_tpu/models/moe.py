@@ -35,6 +35,9 @@ from llm_d_kv_cache_manager_tpu.models.llama import (
     _rms_norm,
     next_token_nll,
 )
+from llm_d_kv_cache_manager_tpu.ops.ring_attention import (
+    ring_for_mesh,
+)
 
 Params = Dict[str, Any]
 
@@ -245,17 +248,36 @@ def forward(
     tokens: jnp.ndarray,
     cfg: MoEConfig,
     use_flash: bool = True,
+    sp_mesh=None,
+    ring_impl: str = "auto",
+    ring_interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Dense forward: tokens [B, T] -> (logits [B, T, V], aux loss)."""
+    """Dense forward: tokens [B, T] -> (logits [B, T, V], aux loss).
+
+    ``sp_mesh``: long-context prefill via ring attention over the
+    ``sp`` axis, same wiring as the flagship model (llama.forward).
+    CONTIGUOUS layout only: the striped layout reorders tokens, and
+    MoE capacity routing is token-order-sensitive (drops are consumed
+    in array order), so striping would silently change which tokens
+    overflow — llama-only until striped-aware capacity ordering
+    exists."""
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
     x = jnp.take(params["embed"], tokens, axis=0)
+    ring = None
+    if sp_mesh is not None:
+        ring = ring_for_mesh(
+            sp_mesh, impl=ring_impl, interpret=ring_interpret
+        )
 
     def layer(carry, lp):
         x, aux = carry
         h = _rms_norm(x, lp["ln1"])
         q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
-        attn = _prefill_attention(q, k, v, cfg, use_flash=use_flash)
+        if ring is not None:
+            attn = ring(q, k, v)
+        else:
+            attn = _prefill_attention(q, k, v, cfg, use_flash=use_flash)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         moe_out, layer_aux = _moe_mlp(_rms_norm(x, lp["ln2"]), lp, cfg)
         return (x + moe_out, aux + layer_aux), None

@@ -337,6 +337,35 @@ def ring_attention_sharded(
     )
 
 
+def ring_for_mesh(
+    sp_mesh: Mesh,
+    striped: bool = False,
+    impl: str = "auto",
+    interpret: bool = False,
+):
+    """Model-layer convenience: the sharded ring with the standard
+    axis gating — batch rides ``dp`` and heads ride ``tp`` when those
+    axes exist with degree > 1 (declaring tp-sharded heads replicated
+    would all-gather them every layer).  One helper so every model
+    family (llama, moe, ...) gates identically."""
+
+    def axis_if_used(name):
+        return (
+            name
+            if name in sp_mesh.axis_names and sp_mesh.shape[name] > 1
+            else None
+        )
+
+    return ring_attention_sharded(
+        sp_mesh,
+        batch_axis=axis_if_used("dp"),
+        head_axis=axis_if_used("tp"),
+        striped=striped,
+        impl=impl,
+        interpret=interpret,
+    )
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

@@ -30,6 +30,33 @@ def test_forward_shapes_and_finite():
     assert float(aux) > 0  # balanced routing gives aux ~= 1
 
 
+def test_forward_ring_matches_dense():
+    """Long-context prefill for the MoE family: ring attention over an
+    sp mesh (contiguous layout; striped is llama-only because MoE
+    capacity routing is token-order-sensitive) must match the dense
+    forward — einsum body and mask-aware flash body both."""
+    params = moe.init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 512)
+    mesh = make_mesh(MeshPlan(dp=1, sp=8), devices=jax.devices()[:8])
+    dense, dense_aux = moe.forward(params, tokens, CFG, use_flash=False)
+    for impl, interpret in (("einsum", False), ("flash", True)):
+        logits, aux = jax.jit(
+            lambda p, t, i=impl, ip=interpret: moe.forward(
+                p, t, CFG, sp_mesh=mesh, ring_impl=i, ring_interpret=ip
+            )
+        )(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(logits),
+            np.asarray(dense),
+            rtol=2e-4,
+            atol=2e-4,
+            err_msg=impl,
+        )
+        np.testing.assert_allclose(
+            float(aux), float(dense_aux), rtol=1e-5
+        )
+
+
 class TestRouting:
     def setup_method(self):
         rng = np.random.default_rng(0)
