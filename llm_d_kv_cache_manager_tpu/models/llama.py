@@ -301,6 +301,22 @@ def forward(
     return _logits(x, params)
 
 
+def _scatter_kv_blocks(kv_layer, k, v, block_ids, block_size):
+    """Write per-token K/V ([B, T, Hkv, Dh] each, T a multiple of
+    ``block_size``) into the pool blocks named by ``block_ids``
+    ([B, T/block_size]).  ONE layout for every prefill path — were it
+    duplicated, a pool layout change could silently diverge between
+    them."""
+    B, T = k.shape[:2]
+    kv = jnp.stack((k, v), axis=2)  # [B, T, 2, Hkv, Dh]
+    kv = kv.reshape(
+        B, T // block_size, block_size, 2, kv.shape[-2], kv.shape[-1]
+    ).transpose(0, 1, 3, 2, 4, 5)  # [B, nb, 2, block, Hkv, Dh]
+    return kv_layer.at[block_ids.reshape(-1)].set(
+        kv.reshape((-1,) + kv.shape[2:]).astype(kv_layer.dtype)
+    )
+
+
 def prefill_paged(
     params: Params,
     tokens: jnp.ndarray,
@@ -317,7 +333,6 @@ def prefill_paged(
     Returns (logits [B, T, V], new kv_pool).
     """
     B, T = tokens.shape
-    nb = T // cfg.block_size
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
     x = jnp.take(params["embed"], tokens, axis=0)
 
@@ -328,12 +343,8 @@ def prefill_paged(
         attn = _prefill_attention(q, k, v, cfg)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
-        # [B, T, Hkv, Dh] -> [B, nb, block, Hkv, Dh] -> pool scatter
-        kv = jnp.stack((k, v), axis=2)  # [B, T, 2, Hkv, Dh]
-        kv = kv.reshape(B, nb, cfg.block_size, 2, kv.shape[-2], kv.shape[-1])
-        kv = kv.transpose(0, 1, 3, 2, 4, 5)  # [B, nb, 2, block, Hkv, Dh]
-        kv_layer = kv_layer.at[block_table.reshape(-1)].set(
-            kv.reshape((-1,) + kv.shape[2:]).astype(kv_layer.dtype)
+        kv_layer = _scatter_kv_blocks(
+            kv_layer, k, v, block_table, cfg.block_size
         )
         return x, kv_layer
 
@@ -393,17 +404,122 @@ def prefill_continue(
         attn = _prefill_attention(q, k_full, v_full, cfg, q_offset=prefix_len)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
         x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
-        kv = jnp.stack((k, v), axis=2)  # [B, Ts, 2, Hkv, Dh]
-        kv = kv.reshape(
-            B, nsuf, cfg.block_size, 2, kv.shape[-2], kv.shape[-1]
-        ).transpose(0, 1, 3, 2, 4, 5)
-        kv_layer = kv_layer.at[suffix_ids.reshape(-1)].set(
-            kv.reshape((-1,) + kv.shape[2:]).astype(kv_layer.dtype)
+        kv_layer = _scatter_kv_blocks(
+            kv_layer, k, v, suffix_ids, cfg.block_size
         )
         return x, kv_layer
 
     x, kv_pool = lax.scan(layer, x, (params["layers"], kv_pool))
     return _logits(x, params), kv_pool
+
+
+def prefill_chunked(
+    params: Params,
+    tokens: jnp.ndarray,
+    kv_pool: jnp.ndarray,
+    block_table: jnp.ndarray,
+    cfg: LlamaConfig,
+    chunk_tokens: int = 2048,
+    seq_len: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Bounded-memory long-prompt prefill (vLLM's chunked prefill in
+    the paged-pool design): the prompt is processed in fixed-size
+    chunks, each writing its K/V blocks into the pool FIRST and then
+    attending over everything written so far through the blockwise
+    flash op — whose dynamic ``q_offset`` makes this ONE compiled
+    chunk step regardless of prompt length, with runtime-skipped
+    masked chunks.  Network activations are O(chunk) instead of O(T);
+    the per-layer K/V gather still materializes the O(T) context
+    (like prefill_continue's prefix gather) — what this bounds is the
+    activation side, not the KV read.
+
+    tokens: [B, T] with T % chunk_tokens == 0 and chunk_tokens %
+    block_size == 0; block_table: [B, T / block_size].  ``seq_len``
+    ([B], defaults to T everywhere): each sequence's TRUE length —
+    prompts are padded up to a chunk multiple, and the returned
+    logits are taken at position ``seq_len-1``, never at a pad
+    position (pad tokens still run and write scratch blocks, but
+    causality keeps them invisible to real positions).
+    Returns (true-last-position logits [B, V], new kv_pool) — the
+    serving contract (the next sampled token); intermediate
+    positions' logits are not materialized.
+    """
+    B, T = tokens.shape
+    C = chunk_tokens
+    if T % C or C % cfg.block_size:
+        raise ValueError(
+            "chunk_tokens must divide T, and block_size must divide "
+            f"chunk_tokens (T={T}, chunk={C}, block={cfg.block_size})"
+        )
+    n_chunks = T // C
+    blocks_per_chunk = C // cfg.block_size
+    Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
+    if seq_len is None:
+        seq_len = jnp.full((B,), T, jnp.int32)
+    # Clamped into range: an out-of-range length (caller forgot the
+    # pad, off-by-one) must select a real position — otherwise no
+    # chunk ever matches and the serving logits would silently come
+    # from the zero-initialized carry.
+    last_pos = jnp.clip(seq_len - 1, 0, T - 1)  # [B]
+
+    def chunk_step(carry, i):
+        kv_pool, last_h = carry
+        start = i * C
+        tok = lax.dynamic_slice_in_dim(tokens, start, C, axis=1)
+        positions = jnp.broadcast_to(jnp.arange(C), (B, C)) + start
+        x = jnp.take(params["embed"], tok, axis=0)
+        chunk_ids = lax.dynamic_slice_in_dim(
+            block_table, i * blocks_per_chunk, blocks_per_chunk, axis=1
+        )
+
+        def layer(x, inputs):
+            lp, kv_layer = inputs
+            h = _rms_norm(x, lp["ln1"])
+            q, k, v = _qkv(h, lp, positions, cfg.rope_theta)
+            # Scatter this chunk's K/V first: its keys then live in
+            # the pool like every earlier chunk's, and ONE gathered
+            # read serves the whole causal context.
+            kv_layer = _scatter_kv_blocks(
+                kv_layer, k, v, chunk_ids, cfg.block_size
+            )
+            full = jnp.take(kv_layer, block_table, axis=0)
+            # [B, nb, 2, bs, Hkv, Dh] -> [B, T, Hkv, Dh] per half.
+            k_full = full[:, :, 0].reshape(B, T, Hkv, Dh).astype(
+                k.dtype
+            )
+            v_full = full[:, :, 1].reshape(B, T, Hkv, Dh).astype(
+                v.dtype
+            )
+            # Causal mask with the chunk's dynamic offset hides every
+            # pool position beyond the chunk's last token, including
+            # blocks not written yet.
+            attn = flash_gqa_attention(
+                q, k_full, v_full, q_offset=start
+            )
+            x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+            x = x + _mlp(_rms_norm(x, lp["ln2"]), lp)
+            return x, kv_layer
+
+        x, kv_pool = lax.scan(layer, x, (params["layers"], kv_pool))
+        # Pick each sequence's TRUE last hidden state when it falls in
+        # this chunk (ragged lengths: pad positions must never produce
+        # the serving logits).  Hidden state only — projecting every
+        # chunk to [B, V] would run n_chunks vocab matmuls for
+        # discarded outputs.
+        in_chunk = last_pos // C == i  # [B]
+        offset = jnp.clip(last_pos - start, 0, C - 1)
+        picked = jnp.take_along_axis(
+            x, offset[:, None, None].repeat(x.shape[-1], 2), axis=1
+        )[:, 0]
+        last_h = jnp.where(in_chunk[:, None], picked, last_h)
+        return (kv_pool, last_h), None
+
+    (kv_pool, last_h), _ = lax.scan(
+        chunk_step,
+        (kv_pool, jnp.zeros((B, cfg.d_model), jnp.dtype(cfg.dtype))),
+        jnp.arange(n_chunks),
+    )
+    return _logits(last_h, params), kv_pool
 
 
 def decode_step(

@@ -122,6 +122,86 @@ def test_paged_decode_matches_dense(params):
         )
 
 
+def test_prefill_chunked_matches_full(params):
+    """Chunked prefill (bounded-memory long-prompt path, one compiled
+    chunk step with dynamic q_offset) must write the same pool and
+    produce the same last-position logits as the one-shot paged
+    prefill, and decode must continue off its pool exactly."""
+    B, T, C = 2, 32, 8
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(15), (B, T), 0, CFG.vocab_size
+    )
+    nb = T // CFG.block_size
+    pool = jnp.zeros(
+        (
+            CFG.n_layers,
+            B * nb + B,
+            2,
+            CFG.block_size,
+            CFG.n_kv_heads,
+            CFG.head_dim,
+        ),
+        jnp.float32,
+    )
+    table = jnp.arange(B * nb, dtype=jnp.int32).reshape(B, nb)
+
+    full_logits, full_pool = llama.prefill_paged(
+        params, tokens, pool, table, CFG
+    )
+    chunk_last, chunk_pool = llama.prefill_chunked(
+        params, tokens, jnp.zeros_like(pool), table, CFG, chunk_tokens=C
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunk_last),
+        np.asarray(full_logits[:, -1]),
+        rtol=2e-4,
+        atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunk_pool), np.asarray(full_pool), rtol=2e-4, atol=2e-4
+    )
+
+    # Decode continues off the chunked pool exactly as off the dense
+    # forward (the serving handoff).
+    extra = jnp.arange(B, dtype=jnp.int32)[:, None] + B * nb
+    table_d = jnp.concatenate([table, extra], axis=1)
+    nxt = jnp.argmax(chunk_last, -1)
+    seq = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    ctx = jnp.full((B,), T + 1, jnp.int32)
+    logits, _ = llama.decode_step(
+        params, nxt, chunk_pool, table_d, ctx, CFG
+    )
+    dense = llama.forward(params, seq, CFG)[:, -1]
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(dense), rtol=2e-4, atol=2e-4
+    )
+
+    # Ragged lengths: prompts padded up to a chunk multiple must get
+    # their logits at the TRUE last position, never a pad position —
+    # and sequences ending in different chunks both resolve.
+    seq_len = jnp.asarray([T - C - 3, T - 1], jnp.int32)
+    ragged_last, _ = llama.prefill_chunked(
+        params,
+        tokens,
+        jnp.zeros_like(pool),
+        table,
+        CFG,
+        chunk_tokens=C,
+        seq_len=seq_len,
+    )
+    for b in range(B):
+        expect = llama.forward(
+            params, tokens[b : b + 1, : int(seq_len[b])], CFG
+        )[0, -1]
+        np.testing.assert_allclose(
+            np.asarray(ragged_last[b]),
+            np.asarray(expect),
+            rtol=2e-4,
+            atol=2e-4,
+            err_msg=f"sequence {b}",
+        )
+
+
 def test_ring_attention_matches_dense():
     mesh = make_mesh(MeshPlan(dp=2, sp=4))
     B, T, H, D = 2, 16, 4, 8
