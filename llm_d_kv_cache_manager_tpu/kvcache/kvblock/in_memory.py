@@ -63,20 +63,35 @@ class InMemoryIndex(Index):
             raise ValueError("no request keys provided for lookup")
 
         pods_per_key: Dict[int, List[PodEntry]] = {}
-        for key in request_keys:
-            pod_cache = self._data.get(key)
+        # Two batched lock round-trips for the whole chain instead of
+        # one per key (a long-prompt lookup walks hundreds): peek
+        # first, then refresh recency ONLY for keys that yielded pods
+        # — never the dead break key or the unreachable suffix, which
+        # would push live entries out under LRU pressure.  Deferring
+        # the touch does widen the window in which a concurrent add
+        # can evict a key this lookup already read (the old per-key
+        # get made each key MRU before examining the next); that race
+        # existed between get and snapshot anyway, and the index is
+        # advisory — continuously rebuilt from engine events — so a
+        # transiently stale read is the accepted cost of the batching.
+        caches = self._data.peek_many(request_keys)
+        touched: List[int] = []
+        for key, pod_cache in zip(request_keys, caches):
             if pod_cache is None:
                 continue
             pods = pod_cache.snapshot()
             if not pods:
                 # The prefix chain is broken here for every pod: stop.
-                return pods_per_key
+                break
+            touched.append(key)
             if pod_identifier_set:
                 pods = [
                     p for p in pods if p.pod_identifier in pod_identifier_set
                 ]
             if pods:
                 pods_per_key[key] = pods
+        if touched:
+            self._data.touch_many(touched)
         return pods_per_key
 
     def add(

@@ -176,6 +176,21 @@ class TestInMemorySpecifics:
         assert found == {21: [POD1]}
 
 
+    def test_lookup_batched_get_refreshes_recency(self):
+        """lookup batches its locking (LRUCache.peek_many, then one
+        touch_many for the keys that yielded pods); a looked-up key
+        must end as recency-fresh as a per-key get would have left it
+        — the next insert evicts an UNTOUCHED key, not the looked-up
+        one."""
+        index = InMemoryIndex(InMemoryIndexConfig(size=2))
+        index.add([1], [11], [POD1])
+        index.add([2], [12], [POD1])
+        index.lookup([11])  # refreshes 11; 12 is now the LRU victim
+        index.add([3], [13], [POD1])
+        assert index.lookup([11, 13]) == {11: [POD1], 13: [POD1]}
+        assert index.lookup([11, 12, 13]) == {11: [POD1], 13: [POD1]}
+
+
 class TestCostAwareSpecifics:
     def test_budget_eviction(self):
         index = CostAwareMemoryIndex(CostAwareIndexConfig(max_cost_bytes=2000))
