@@ -115,10 +115,46 @@ class TokenizationPool:
         render_req: Optional[ApplyChatTemplateRequest] = None,
         timeout: Optional[float] = 60.0,
     ) -> List[int]:
-        """Synchronous tokenization through the pool."""
+        """Synchronous tokenization through the pool.
+
+        Plain prompts probe the prefix store in the CALLING thread
+        first: a steady-state scoring request whose stream is cached
+        skips the queue + worker round-trip entirely (the pool exists
+        to parallelize the SLOW full tokenizer, not a store read —
+        the store is already read concurrently by the workers, so the
+        extra reader is safe).  Chat-rendered prompts must render
+        first and stay on the queue."""
+        if render_req is None:
+            served = self._try_prefix_fast_path(
+                prompt, model_name or self.config.model_name
+            )
+            if served is not None:
+                return served
         future: "Future[List[int]]" = Future()
         self._submit(prompt, model_name, render_req, future)
         return future.result(timeout=timeout)
+
+    def _try_prefix_fast_path(
+        self, prompt: str, model_name: str
+    ) -> Optional[List[int]]:
+        """The cached token stream when store coverage clears the
+        fast-path threshold; None otherwise.  Shared by the sync
+        caller path and the worker (_process)."""
+        tokens, overlap_ratio = (
+            self._prefix_store.find_longest_contained_tokens(
+                prompt, model_name
+            )
+        )
+        if overlap_ratio >= self.config.min_prefix_overlap_ratio:
+            METRICS.tokenization_prefix_fast_path.inc()
+            trace(
+                logger,
+                "prefix-store fast path: %d tokens at %.2f coverage",
+                len(tokens),
+                overlap_ratio,
+            )
+            return tokens
+        return None
 
     def enqueue_tokenization(
         self,
@@ -191,20 +227,9 @@ class TokenizationPool:
             )
             add_special_tokens = False
 
-        tokens, overlap_ratio = (
-            self._prefix_store.find_longest_contained_tokens(
-                prompt, task.model_name
-            )
-        )
-        if overlap_ratio >= self.config.min_prefix_overlap_ratio:
-            METRICS.tokenization_prefix_fast_path.inc()
-            trace(
-                logger,
-                "prefix-store fast path: %d tokens at %.2f coverage",
-                len(tokens),
-                overlap_ratio,
-            )
-            return tokens
+        served = self._try_prefix_fast_path(prompt, task.model_name)
+        if served is not None:
+            return served
 
         encoding = self._tokenizer.encode(
             prompt, task.model_name, add_special_tokens
