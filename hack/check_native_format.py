@@ -23,6 +23,18 @@ therefore indicates code the authoritative gate would also reject or
 that was never formatted.  Exit 0 = clean, 1 = violations (one line
 each: path:line: message).
 
+Known false-negative class (column check only): the unbreakable-token
+carve-out (``_is_breakable_overflow``) looks for a break opportunity at
+or past column 79 only.  An over-limit line whose ONLY spaces sit
+before that column — e.g. a short prefix followed by one giant token,
+``return kVeryLongUnbreakableIdentifierThatRunsPastTheLimit...`` — is
+treated as unbreakable and passes, even though clang-format would have
+wrapped at the early space and THEN left the token overflowing on its
+own line (or, for a breakable tail, not overflowed at all).  Deciding
+that correctly requires clang-format's break-cost model; this gate
+stays conservative (never a false positive on formatted code) and
+leaves the class to the authoritative CI gate.
+
 Usage: python hack/check_native_format.py [files...]
 (defaults to llm_d_kv_cache_manager_tpu/native/src/*.cpp|hpp)
 """
@@ -46,7 +58,9 @@ def _is_breakable_overflow(line: str) -> bool:
     clang-format (ColumnLimit 80) only exceeds the limit when a single
     unbreakable token — long string literal, include path, URL — runs
     past it, i.e. when there is no break opportunity (space) at or
-    beyond the last column."""
+    beyond the last column.  False negative: over-limit lines whose
+    only break opportunities sit before column 79 pass here (see the
+    module docstring)."""
     return " " in line[MAX_COLS - 1:].strip()
 
 

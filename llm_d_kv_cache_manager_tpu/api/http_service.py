@@ -36,7 +36,12 @@ logger = get_logger("api.http_service")
 MAX_BODY_BYTES = 16 * 1024 * 1024
 
 
-def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
+def _make_handler(
+    indexer: Indexer,
+    admin_token: Optional[str] = None,
+    persistence=None,
+    recovery_report=None,
+):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         # Socket timeout (StreamRequestHandler applies it in setup()):
@@ -149,7 +154,12 @@ def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
             elif self.path == "/healthz":
-                self._reply_json(200, {"status": "ok"})
+                health = {"status": "ok"}
+                if recovery_report is not None:
+                    health["recovery"] = recovery_report.to_dict()
+                if persistence is not None:
+                    health["persistence"] = persistence.status()
+                self._reply_json(200, health)
             else:
                 self._error(404, "not found")
 
@@ -176,6 +186,8 @@ def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
                     self._score_chat_completions()
                 elif self.path == "/admin/purge_pod":
                     self._purge_pod()
+                elif self.path == "/admin/snapshot":
+                    self._snapshot()
                 else:
                     self._error(404, "not found")
             finally:
@@ -222,6 +234,36 @@ def _make_handler(indexer: Indexer, admin_token: Optional[str] = None):
                 self._error(500, f"error: {exc}")
                 return
             self._reply_json(200, {"pod": pod, "removed": removed})
+
+        def _snapshot(self):
+            """Operator trigger: publish an index snapshot now (e.g.
+            before a planned restart or rollout).  Admin-gated like
+            purge_pod; 503 when the service runs without persistence.
+            An empty body is allowed — the endpoint takes no fields."""
+            if not self._admin_allowed():
+                self._error(403, "admin endpoint: token or loopback only")
+                return
+            if self._declares_body():
+                if self._read_json() is None:
+                    return
+            if persistence is None:
+                self._error(503, "persistence not configured")
+                return
+            try:
+                info = persistence.snapshot(indexer.kv_block_index)
+            except Exception as exc:
+                logger.exception("snapshot failed")
+                self._error(500, f"error: {exc}")
+                return
+            self._reply_json(
+                200,
+                {
+                    "path": info.path,
+                    "bytes": info.size_bytes,
+                    "block_keys": info.block_keys,
+                    "engine_mappings": info.engine_mappings,
+                },
+            )
 
         def _score_completions(self):
             request = self._read_json()
@@ -287,13 +329,24 @@ def serve(
     host: str = "0.0.0.0",
     port: int = 8080,
     admin_token: Optional[str] = None,
+    persistence=None,
+    recovery_report=None,
 ) -> http.server.ThreadingHTTPServer:
     """Start the HTTP service on a background thread; returns the server
     (call ``.shutdown()`` to stop).  ``admin_token`` (env:
     ``ADMIN_TOKEN``) gates ``/admin/*``; without one, admin calls are
-    accepted from loopback only."""
+    accepted from loopback only.  ``persistence`` (a
+    ``PersistenceManager``) enables ``POST /admin/snapshot`` and the
+    persistence block in ``/healthz``; ``recovery_report`` surfaces the
+    startup recovery outcome there too."""
     server = http.server.ThreadingHTTPServer(
-        (host, port), _make_handler(indexer, admin_token=admin_token)
+        (host, port),
+        _make_handler(
+            indexer,
+            admin_token=admin_token,
+            persistence=persistence,
+            recovery_report=recovery_report,
+        ),
     )
     thread = threading.Thread(
         target=server.serve_forever, name="http-service", daemon=True
@@ -358,12 +411,40 @@ def main() -> None:  # pragma: no cover - CLI entry
     indexer = Indexer(config)
     indexer.run()
 
+    # PERSISTENCE_DIR enables warm restarts: recover the index from the
+    # last snapshot + journal tail BEFORE the event pool starts, then
+    # journal every applied event and snapshot periodically.
+    persistence = None
+    recovery_report = None
+    stop_snapshots = None
+    if os.environ.get("PERSISTENCE_DIR"):
+        from llm_d_kv_cache_manager_tpu.persistence import (
+            PersistenceConfig,
+            PersistenceManager,
+        )
+
+        persistence = PersistenceManager(
+            PersistenceConfig(
+                directory=os.environ["PERSISTENCE_DIR"],
+                journal_fsync=os.environ.get(
+                    "PERSISTENCE_FSYNC", ""
+                ).lower()
+                in ("1", "true", "yes"),
+            )
+        )
+        recovery_report = persistence.recover(indexer.kv_block_index)
+        stop_snapshots = persistence.start_auto_snapshot(
+            indexer.kv_block_index,
+            float(os.environ.get("PERSISTENCE_SNAPSHOT_INTERVAL", "300")),
+        )
+
     pool = Pool(
         indexer.kv_block_index,
         indexer.token_processor,
         PoolConfig(
             concurrency=int(os.environ.get("POOL_CONCURRENCY", "4"))
         ),
+        journal=persistence.journal if persistence else None,
     )
     pool.start()
     # Two event-ingestion modes (reference online example supports both):
@@ -415,6 +496,8 @@ def main() -> None:  # pragma: no cover - CLI entry
         indexer,
         port=int(os.environ.get("HTTP_PORT", "8080")),
         admin_token=os.environ.get("ADMIN_TOKEN"),
+        persistence=persistence,
+        recovery_report=recovery_report,
     )
     try:
         threading.Event().wait()
@@ -422,11 +505,21 @@ def main() -> None:  # pragma: no cover - CLI entry
         pass
     finally:
         stop_beat.set()
+        if stop_snapshots is not None:
+            stop_snapshots.set()
         server.shutdown()
         if reconciler is not None:
             reconciler.stop()
         manager.shutdown()
         pool.shutdown()
+        if persistence is not None:
+            # Parting snapshot: the next start recovers warm even if
+            # the periodic beat never fired.
+            try:
+                persistence.snapshot(indexer.kv_block_index)
+            except Exception:  # noqa: BLE001 - best-effort on the way out
+                logger.exception("shutdown snapshot failed")
+            persistence.close()
         indexer.shutdown()
 
 

@@ -70,6 +70,76 @@ def encode_canonical(item: Any) -> bytes:
     return bytes(out)
 
 
+class CborDecodeError(ValueError):
+    """Malformed or unsupported CBOR input."""
+
+
+def _read_head(data: bytes, pos: int) -> tuple:
+    """Decode one major-type head; returns (major, value, next_pos)."""
+    if pos >= len(data):
+        raise CborDecodeError("truncated CBOR head")
+    initial = data[pos]
+    major, info = initial >> 5, initial & 0x1F
+    pos += 1
+    if info < 24:
+        return major, info, pos
+    widths = {24: 1, 25: 2, 26: 4, 27: 8}
+    width = widths.get(info)
+    if width is None:
+        raise CborDecodeError(f"unsupported CBOR head info {info}")
+    if pos + width > len(data):
+        raise CborDecodeError("truncated CBOR head argument")
+    return (
+        major,
+        int.from_bytes(data[pos : pos + width], "big"),
+        pos + width,
+    )
+
+
+def _decode_at(data: bytes, pos: int, depth: int = 0) -> tuple:
+    if depth > 64:
+        raise CborDecodeError("CBOR nesting too deep")
+    if pos < len(data) and data[pos] in (0xF4, 0xF5, 0xF6):
+        simple = {0xF4: False, 0xF5: True, 0xF6: None}[data[pos]]
+        return simple, pos + 1
+    major, value, pos = _read_head(data, pos)
+    if major == 0:
+        return value, pos
+    if major == 1:
+        return -1 - value, pos
+    if major in (2, 3):
+        if pos + value > len(data):
+            raise CborDecodeError("truncated CBOR string body")
+        raw = data[pos : pos + value]
+        if major == 3:
+            try:
+                return raw.decode("utf-8"), pos + value
+            except UnicodeDecodeError as exc:
+                raise CborDecodeError(f"invalid UTF-8 text: {exc}") from exc
+        return raw, pos + value
+    if major == 4:
+        out = []
+        for _ in range(value):
+            item, pos = _decode_at(data, pos, depth + 1)
+            out.append(item)
+        return out, pos
+    raise CborDecodeError(f"unsupported CBOR major type {major}")
+
+
+def decode_canonical(data: bytes) -> Any:
+    """Decode bytes produced by :func:`encode_canonical` (same type
+    subset: ints, byte/text strings, lists, booleans, null).  Raises
+    :class:`CborDecodeError` on truncation, trailing garbage, or types
+    outside the subset — a torn snapshot/journal record must fail
+    loudly, never decode to a half-document."""
+    item, pos = _decode_at(data, 0)
+    if pos != len(data):
+        raise CborDecodeError(
+            f"{len(data) - pos} trailing bytes after CBOR item"
+        )
+    return item
+
+
 def encode_hash_payload(
     parent: int, tokens: Sequence[int] | None, extra: Any
 ) -> bytes:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
     CostAwareIndexConfig,
@@ -145,6 +145,53 @@ class CostAwareMemoryIndex(Index):
         if request_key is None:
             raise KeyError(f"engine key not found: {engine_key:#x}")
         return request_key
+
+    def dump_entries(
+        self,
+    ) -> Tuple[List[Tuple[int, List[PodEntry]]], List[Tuple[int, int]]]:
+        with self._lock:
+            block_entries = [
+                (request_key, list(pods))
+                for request_key, pods in self._data.items()
+            ]
+            engine_map = list(self._engine_to_request.items())
+        return block_entries, engine_map
+
+    def restore_entries(
+        self,
+        block_entries: Sequence[Tuple[int, Sequence[PodEntry]]],
+        engine_map: Sequence[Tuple[int, int]],
+    ) -> int:
+        restored = 0
+        with self._lock:
+            for request_key, entries in block_entries:
+                if not entries:
+                    continue
+                pods = self._data.get(request_key)
+                if pods is None:
+                    pods = OrderedDict()
+                    self._data[request_key] = pods
+                    self._cost += _KEY_OVERHEAD
+                else:
+                    self._data.move_to_end(request_key)
+                for entry in entries:
+                    if entry not in pods:
+                        cost = _entry_cost(entry)
+                        pods[entry] = cost
+                        self._cost += cost
+                    else:
+                        pods.move_to_end(entry)
+                while len(pods) > self.config.pod_cache_size:
+                    _, cost = pods.popitem(last=False)
+                    self._cost -= cost
+                restored += 1
+            for engine_key, request_key in engine_map:
+                self._engine_to_request[engine_key] = request_key
+                self._request_to_engines.setdefault(request_key, set()).add(
+                    engine_key
+                )
+            self._evict_to_budget_locked()
+        return restored
 
     def purge_pod(self, pod_identifier: str) -> int:
         removed = 0

@@ -10,7 +10,7 @@ insert.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
     Index,
@@ -143,6 +143,46 @@ class InMemoryIndex(Index):
         if request_key is None:
             raise KeyError(f"engine key not found: {engine_key:#x}")
         return request_key
+
+    def dump_entries(
+        self,
+    ) -> Tuple[List[Tuple[int, List[PodEntry]]], List[Tuple[int, int]]]:
+        # keys() snapshots LRU-first; a concurrent eviction between the
+        # key snapshot and the per-key peek just drops that key from
+        # the dump — the journal replays whatever raced past the dump.
+        block_entries: List[Tuple[int, List[PodEntry]]] = []
+        for request_key in self._data.keys():
+            pod_cache = self._data.peek(request_key)
+            if pod_cache is None:
+                continue
+            pods = pod_cache.snapshot()
+            if pods:
+                block_entries.append((request_key, pods))
+        engine_map = [
+            (engine_key, request_key)
+            for engine_key, request_key in self._engine_to_request.items()
+        ]
+        return block_entries, engine_map
+
+    def restore_entries(
+        self,
+        block_entries: Sequence[Tuple[int, Sequence[PodEntry]]],
+        engine_map: Sequence[Tuple[int, int]],
+    ) -> int:
+        restored = 0
+        for request_key, pods in block_entries:
+            if not pods:
+                continue
+            pod_cache = self._data.get(request_key)
+            if pod_cache is None:
+                pod_cache = self._data.put_if_absent(
+                    request_key, _PodCache(self.config.pod_cache_size)
+                )
+            pod_cache.add_all(list(pods))
+            restored += 1
+        for engine_key, request_key in engine_map:
+            self._engine_to_request.put(engine_key, request_key)
+        return restored
 
     def purge_pod(self, pod_identifier: str) -> int:
         removed = 0

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
     EMPTY_BLOCK_HASH,
@@ -85,6 +85,42 @@ class Index(ABC):
 
         Raises ``KeyError`` if the mapping is missing (e.g. already
         evicted).
+        """
+
+    @abstractmethod
+    def dump_entries(
+        self,
+    ) -> Tuple[List[Tuple[int, List[PodEntry]]], List[Tuple[int, int]]]:
+        """Serialize the index for a persistence snapshot.
+
+        Returns ``(block_entries, engine_map)``: ``block_entries`` is
+        ``[(request_key, [PodEntry, ...]), ...]`` and ``engine_map`` is
+        ``[(engine_key, request_key), ...]``.  Both are ordered
+        least-recently-used first so a capacity-bounded
+        :meth:`restore_entries` re-evicts the same victims the live
+        index would have.  The dump is a point-in-time snapshot taken
+        under the backend's own locking discipline; concurrent writers
+        may land either side of it (the persistence journal covers the
+        gap — see ``persistence/``).
+
+        Backends whose store is already durable (Redis/Valkey) return
+        empty lists: their state survives an indexer restart without
+        any snapshot (documented no-op).
+        """
+
+    @abstractmethod
+    def restore_entries(
+        self,
+        block_entries: Sequence[Tuple[int, Sequence[PodEntry]]],
+        engine_map: Sequence[Tuple[int, int]],
+    ) -> int:
+        """Load a :meth:`dump_entries` dump; returns block keys restored.
+
+        Applies the dump through the backend's normal admission path, so
+        capacity/budget bounds hold (an oversized dump is truncated by
+        the same LRU policy as live traffic).  Safe on a non-empty
+        index: restoring an entry that already exists is idempotent.
+        Durable backends (Redis) are a no-op returning 0.
         """
 
     @abstractmethod

@@ -62,6 +62,15 @@ class InstrumentedIndex(Index):
     def get_request_key(self, engine_key: int) -> int:
         return self._inner.get_request_key(engine_key)
 
+    def dump_entries(self):
+        return self._inner.dump_entries()
+
+    def restore_entries(self, block_entries, engine_map) -> int:
+        restored = self._inner.restore_entries(block_entries, engine_map)
+        if restored:
+            METRICS.index_admissions.inc(restored)
+        return restored
+
     def purge_pod(self, pod_identifier: str) -> int:
         removed = self._inner.purge_pod(pod_identifier)
         if removed:

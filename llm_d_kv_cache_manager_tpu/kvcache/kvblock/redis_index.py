@@ -461,6 +461,22 @@ class RedisIndex(Index):
             raise KeyError(f"engine key not found: {engine_key:#x}")
         return int(raw.decode())
 
+    def dump_entries(self):
+        """Documented no-op: Redis/Valkey IS the durable store.
+
+        The persistence subsystem exists so the in-process backends
+        survive an indexer restart; this backend's state already lives
+        server-side and outlives the process (and is shared by every
+        indexer replica), so snapshotting it through the file layer
+        would only produce a stale second copy that recovery could
+        resurrect over fresher server state.  See docs/persistence.md.
+        """
+        return [], []
+
+    def restore_entries(self, block_entries, engine_map) -> int:
+        """Documented no-op (see :meth:`dump_entries`); returns 0."""
+        return 0
+
     def purge_pod(self, pod_identifier: str) -> int:
         """SCAN-walk the request hashes, HDEL the pod's fields.
 

@@ -19,6 +19,11 @@ Digest semantics (reference pool.go:233-334):
 
 Poison pills (undecodable payloads) are dropped, never retried.
 
+An optional persistence journal (``persistence/journal.py``) taps the
+post-apply path: every successful ``index.add``/``evict`` is appended as
+an applied-operation record, which is what makes warm indexer restarts
+possible (see docs/persistence.md).
+
 Each shard queue is *bounded* (``PoolConfig.max_queue_depth``, matching the
 reference's bounded per-shard workqueues, pool.go:134-173).  When a shard
 fills — an event storm, or a stuck index backend wedging one worker — the
@@ -99,12 +104,19 @@ class Pool:
         index: Index,
         token_processor: TokenProcessor,
         config: Optional[PoolConfig] = None,
+        journal=None,
     ) -> None:
         self.config = config or PoolConfig()
         if self.config.concurrency <= 0:
             raise ValueError("pool concurrency must be positive")
         self._index = index
         self._token_processor = token_processor
+        # Optional persistence journal (persistence.Journal), tapped
+        # AFTER each index apply succeeds: the journal records applied
+        # operations, so replay needs no token re-hashing and a failed
+        # apply is never journaled.  Per-pod order in the journal
+        # matches apply order structurally (one pod -> one shard).
+        self._journal = journal
         if self.config.max_queue_depth <= 0:
             raise ValueError("pool max_queue_depth must be positive")
         self._queues: List["queue.Queue[Optional[Message]]"] = [
@@ -298,11 +310,20 @@ class Pool:
             request_keys = request_keys[:overlap]
 
         self._index.add(engine_keys, request_keys, entries)
+        if self._journal is not None:
+            self._journal.record_add(
+                message.pod_identifier,
+                message.seq,
+                engine_keys,
+                request_keys,
+                entries,
+            )
 
     def _digest_block_removed(
         self, message: Message, event: BlockRemoved
     ) -> None:
         entries = [PodEntry(message.pod_identifier, self._tier(event.medium))]
+        evicted_keys = []
         for raw_hash in event.block_hashes:
             try:
                 engine_key = engine_hash_to_uint64(raw_hash)
@@ -310,3 +331,8 @@ class Pool:
                 logger.debug("skipping bad removal hash %r: %s", raw_hash, exc)
                 continue
             self._index.evict(engine_key, entries)
+            evicted_keys.append(engine_key)
+        if self._journal is not None and evicted_keys:
+            self._journal.record_evict(
+                message.pod_identifier, message.seq, evicted_keys, entries
+            )

@@ -15,6 +15,7 @@ from typing import Optional
 from prometheus_client import (
     CollectorRegistry,
     Counter,
+    Gauge,
     Histogram,
     generate_latest,
 )
@@ -91,6 +92,40 @@ class KVCacheMetrics:
             f"{_NAMESPACE}_kvevents_seq_gaps_total",
             "Events lost to publisher sequence-number gaps, by pod.",
             ("pod",),
+            registry=self.registry,
+        )
+        self.persistence_journal_records = Counter(
+            f"{_NAMESPACE}_persistence_journal_records_total",
+            "Index operations appended to the persistence journal by op.",
+            ("op",),
+            registry=self.registry,
+        )
+        self.persistence_journal_lag = Gauge(
+            f"{_NAMESPACE}_persistence_journal_records_since_snapshot",
+            "Journal records appended since the last published snapshot "
+            "(replay cost of a crash right now).",
+            registry=self.registry,
+        )
+        self.persistence_snapshot_timestamp = Gauge(
+            f"{_NAMESPACE}_persistence_snapshot_created_timestamp_seconds",
+            "Unix time of the last published index snapshot.",
+            registry=self.registry,
+        )
+        self.persistence_snapshot_bytes = Gauge(
+            f"{_NAMESPACE}_persistence_snapshot_bytes",
+            "Size of the last published index snapshot.",
+            registry=self.registry,
+        )
+        self.persistence_replayed_records = Counter(
+            f"{_NAMESPACE}_persistence_replayed_records_total",
+            "Journal records replayed into the index during recovery.",
+            registry=self.registry,
+        )
+        self.persistence_recoveries = Counter(
+            f"{_NAMESPACE}_persistence_recoveries_total",
+            "Startup recoveries by outcome (warm: state restored; cold: "
+            "nothing on disk).",
+            ("outcome",),
             registry=self.registry,
         )
         self.offload_bytes = Counter(

@@ -51,6 +51,36 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
+def resolve_auto_impl(
+    mesh_platform: str,
+    local_kv_tokens: int,
+    head_dim: int,
+    dtype_bytes: int,
+    interpret: bool = False,
+) -> str:
+    """The step body ``impl="auto"`` resolves to, as a pure function.
+
+    Flash is eligible only where the Pallas kernel will actually run
+    (TPU mesh, or explicit interpret mode) AND the per-step K/V chunk
+    fits the kernel's VMEM staging budget (``flash_pallas.fits_vmem``
+    — the partial stages one kv-head's full local K and V chunk,
+    ``2 * T_local * head_dim`` elements, in VMEM; past the budget the
+    pallas_call fails to lower or silently spills).  Everything else
+    falls back to the einsum body, which streams from HBM.  interpret
+    mode is exempt from the bound: no real VMEM is allocated, and the
+    flag is an explicit request to exercise the Pallas kernel.
+    """
+    from llm_d_kv_cache_manager_tpu.ops.flash_pallas import fits_vmem
+
+    if interpret:
+        return "flash"
+    if mesh_platform != "tpu":
+        return "einsum"
+    if not fits_vmem(local_kv_tokens, head_dim, dtype_bytes):
+        return "einsum"
+    return "flash"
+
+
 def _ring_driver(state, k, v, axis_name: str, accumulate):
     """Ring skeleton shared by both step bodies: K/V rotate around the
     ``axis_name`` ring via ppermute while ``accumulate(state, src,
@@ -293,48 +323,67 @@ def ring_attention_sharded(
     runs the Pallas kernel in interpret mode (CPU tests)."""
     bspec = batch_axis if batch_axis else None
     spec = P(bspec, axis_name, head_axis, None)
-    if impl == "auto":
-        # The mask-aware Pallas body where the kernel will actually
-        # run (the MESH's platform — a CPU debug mesh on a TPU host
-        # must not dispatch pltpu onto CPU devices); the portable
-        # einsum body elsewhere (interpret-mode Pallas is orders
-        # slower than XLA on CPU).  interpret=True is an explicit
-        # request to exercise the Pallas kernel, so it forces flash —
-        # silently resolving to einsum would drop the flag and fake
-        # the coverage the caller asked for.
-        mesh_platform = next(iter(mesh.devices.flat)).platform
-        impl = (
-            "flash"
-            if interpret or mesh_platform == "tpu"
-            else "einsum"
+
+    def build(resolved: str):
+        extra = {}
+        if resolved == "flash":
+            local = functools.partial(
+                _ring_attention_local_flash,
+                axis_name=axis_name,
+                striped=striped,
+                interpret=interpret,
+            )
+            # Pallas calls inside shard_map trip the vma checker (its
+            # interpreter's internal slices don't pvary index
+            # operands); JAX's own error message prescribes
+            # check_vma=False.  Ring exactness is pinned by
+            # tests/test_llama_model.py
+            # (test_flash_ring_matches_dense_both_layouts) instead.
+            extra["check_vma"] = False
+        elif resolved == "einsum":
+            local = functools.partial(
+                _ring_attention_local,
+                axis_name=axis_name,
+                striped=striped,
+            )
+        else:
+            raise ValueError(f"unknown ring impl {resolved!r}")
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            **extra,
         )
-    extra = {}
-    if impl == "flash":
-        local = functools.partial(
-            _ring_attention_local_flash,
-            axis_name=axis_name,
-            striped=striped,
+
+    if impl != "auto":
+        return build(impl)
+
+    # "auto": the mask-aware Pallas body where the kernel will actually
+    # run (the MESH's platform — a CPU debug mesh on a TPU host must
+    # not dispatch pltpu onto CPU devices) AND where each device's K/V
+    # chunk fits the kernel's VMEM staging budget (resolve_auto_impl;
+    # the shape is only known at call/trace time, hence the dispatch
+    # wrapper).  interpret=True is an explicit request to exercise the
+    # Pallas kernel, so it forces flash — silently resolving to einsum
+    # would drop the flag and fake the coverage the caller asked for.
+    mesh_platform = next(iter(mesh.devices.flat)).platform
+    ring = mesh.shape[axis_name]
+    built = {}
+
+    def dispatch(q, k, v):
+        resolved = resolve_auto_impl(
+            mesh_platform,
+            local_kv_tokens=k.shape[1] // ring,
+            head_dim=k.shape[-1],
+            dtype_bytes=jnp.dtype(k.dtype).itemsize,
             interpret=interpret,
         )
-        # Pallas calls inside shard_map trip the vma checker (its
-        # interpreter's internal slices don't pvary index operands);
-        # JAX's own error message prescribes check_vma=False.  Ring
-        # exactness is pinned by tests/test_llama_model.py
-        # (test_flash_ring_matches_dense_both_layouts) instead.
-        extra["check_vma"] = False
-    elif impl == "einsum":
-        local = functools.partial(
-            _ring_attention_local, axis_name=axis_name, striped=striped
-        )
-    else:
-        raise ValueError(f"unknown ring impl {impl!r}")
-    return jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        **extra,
-    )
+        if resolved not in built:
+            built[resolved] = build(resolved)
+        return built[resolved](q, k, v)
+
+    return dispatch
 
 
 def ring_for_mesh(

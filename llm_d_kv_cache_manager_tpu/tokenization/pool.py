@@ -56,6 +56,12 @@ class _Task:
     render_req: Optional[ApplyChatTemplateRequest]
     future: Optional["Future[List[int]]"]
     attempts: int = 0
+    # True when the submitting thread already probed the prefix store
+    # for this exact prompt and missed: the worker skips its own probe
+    # (one store read per miss, not two).  Chat-rendered and
+    # fire-and-forget tasks were never pre-probed, so they keep the
+    # worker-side probe.
+    store_probed: bool = False
 
 
 class TokenizationPool:
@@ -122,16 +128,25 @@ class TokenizationPool:
         skips the queue + worker round-trip entirely (the pool exists
         to parallelize the SLOW full tokenizer, not a store read —
         the store is already read concurrently by the workers, so the
-        extra reader is safe).  Chat-rendered prompts must render
-        first and stay on the queue."""
+        extra reader is safe).  A miss carries ``store_probed`` on the
+        queued task so the worker does not pay a second store read for
+        the same prompt (the store could have been warmed while the
+        task sat queued, but trading that sliver of extra coverage for
+        one probe per miss is the right call on the hot path).
+        Chat-rendered prompts must render first and stay on the
+        queue."""
+        probed = False
         if render_req is None:
             served = self._try_prefix_fast_path(
                 prompt, model_name or self.config.model_name
             )
             if served is not None:
                 return served
+            probed = True
         future: "Future[List[int]]" = Future()
-        self._submit(prompt, model_name, render_req, future)
+        self._submit(
+            prompt, model_name, render_req, future, store_probed=probed
+        )
         return future.result(timeout=timeout)
 
     def _try_prefix_fast_path(
@@ -165,7 +180,9 @@ class TokenizationPool:
         """Fire-and-forget: warm the prefix store off the hot path."""
         self._submit(prompt, model_name, render_req, None)
 
-    def _submit(self, prompt, model_name, render_req, future) -> None:
+    def _submit(
+        self, prompt, model_name, render_req, future, store_probed=False
+    ) -> None:
         self.start()
         self._queue.put(
             _Task(
@@ -173,6 +190,7 @@ class TokenizationPool:
                 model_name=model_name or self.config.model_name,
                 render_req=render_req,
                 future=future,
+                store_probed=store_probed,
             )
         )
 
@@ -227,9 +245,10 @@ class TokenizationPool:
             )
             add_special_tokens = False
 
-        served = self._try_prefix_fast_path(prompt, task.model_name)
-        if served is not None:
-            return served
+        if not task.store_probed:
+            served = self._try_prefix_fast_path(prompt, task.model_name)
+            if served is not None:
+                return served
 
         encoding = self._tokenizer.encode(
             prompt, task.model_name, add_special_tokens

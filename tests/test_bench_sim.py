@@ -220,15 +220,21 @@ class TestHarness:
 
 class TestDriverContract:
     """The driver runs `python bench.py` under an unknown timeout and
-    parses the one JSON line; these guards pin the degrade-don't-die
-    behavior end to end in a real subprocess (tiny geometry, CPU)."""
+    captures only the LAST ~2 KB of stdout; these guards pin the
+    degrade-don't-die behavior AND the tail-survivable emit contract
+    end to end in a real subprocess (tiny geometry, CPU): probe-status
+    line first and second-to-last, compact headline (< 1.5 KB) as the
+    final line, full detail in the results file."""
 
     @staticmethod
     def _run(extra_env):
+        """Returns (full_results_doc, compact_headline, stdout_lines,
+        stderr) after asserting the emit contract's line layout."""
         import json
         import os
         import subprocess
         import sys
+        import tempfile
 
         env = {
             k: v
@@ -237,9 +243,14 @@ class TestDriverContract:
             # must not leak in and flip the truncation asserts.
             if not k.startswith("KVTPU_BENCH_")
         }
+        results_path = os.path.join(
+            tempfile.mkdtemp(prefix="kvtpu-bench-test-"),
+            "results.json",
+        )
         env.update(
             KVTPU_BENCH_PLATFORM="cpu",
             KVTPU_BENCH_TINY="1",
+            KVTPU_BENCH_RESULTS_PATH=results_path,
             JAX_PLATFORMS="cpu",
         )
         env.update(extra_env)
@@ -253,13 +264,31 @@ class TestDriverContract:
             timeout=500,
         )
         assert proc.returncode == 0, proc.stderr[-1500:]
-        return json.loads(proc.stdout), proc.stderr
+        lines = [
+            line for line in proc.stdout.splitlines() if line.strip()
+        ]
+        # Probe diagnosis survives clipping from EITHER end: first
+        # line, and again immediately before the final headline.
+        for probe_line in (lines[0], lines[-2]):
+            probe = json.loads(probe_line)["probe_status"]
+            assert probe["outcome"] in ("ok", "error")
+            assert probe["duration_s"] >= 0
+        # The final line is the compact headline and must survive the
+        # driver's ~2 KB tail capture with margin.
+        assert len(lines[-1].encode()) < 1536, len(lines[-1])
+        compact = json.loads(lines[-1])
+        assert compact["results"] == results_path
+        with open(results_path) as handle:
+            full = json.load(handle)
+        # The compact line mirrors the full artifact's headline.
+        assert compact["value"] == full["value"]
+        return full, compact, lines, proc.stderr
 
     def test_full_tiny_run_emits_all_layers(self):
         # Malformed knobs ride along: they must fall back to defaults
         # (so this stays a FULL run) with a stderr note — asserting the
         # env-fallback contract without paying a third subprocess run.
-        result, stderr = self._run(
+        result, compact, lines, stderr = self._run(
             {
                 "KVTPU_BENCH_BUDGET_S": "half-an-hour",
                 "KVTPU_BENCH_DEVICE_TIMEOUT_S": "900s",
@@ -277,12 +306,20 @@ class TestDriverContract:
         assert "[bench +" in stderr  # phase progress lines
         assert detail["budget_s"] == 1500.0
         assert "ignoring malformed" in stderr
+        # Persistence regime (acceptance): a warm-recovered index must
+        # route at least as well as a cold restart, and the comparison
+        # must ride the compact headline so the driver sees it.
+        restart = compact["indexer_restart"]
+        assert restart == detail["indexer_restart"]
+        assert restart["warm_hit_rate"] >= restart["cold_hit_rate"]
+        assert restart["recovered_block_keys"] > 0
 
     def test_tight_budget_degrades_not_dies(self):
-        result, _ = self._run({"KVTPU_BENCH_BUDGET_S": "1"})
+        result, compact, _, _ = self._run({"KVTPU_BENCH_BUDGET_S": "1"})
         detail = result["detail"]
         # Headline still present and real; optional layers flagged.
         assert result["value"] > 0
+        assert compact["value"] > 0
         assert len(detail["headline_seeds"]) >= 1
         assert detail["decode_truncated"]
         assert detail["matrix_truncated"]
@@ -295,7 +332,9 @@ class TestDriverContract:
         calibrated service times), scoring-RPC percentiles, and the
         index/tokenization microbenches — alongside the explicit error
         and a zeroed headline."""
-        result, stderr = self._run(
+        import json
+
+        result, compact, lines, stderr = self._run(
             {
                 "KVTPU_BENCH_FORCE_DEVICE_ERROR": "wedge-simulation",
             }
@@ -303,6 +342,12 @@ class TestDriverContract:
         assert result["value"] == 0.0
         assert result["vs_baseline"] == 0.0
         assert "wedge-simulation" in result["error"]
+        # The compact headline carries the error; the probe lines
+        # carry the diagnosis (outcome + error class) at both ends.
+        assert "wedge-simulation" in compact["error"]
+        probe = json.loads(lines[0])["probe_status"]
+        assert probe["outcome"] == "error"
+        assert probe["error_class"]
         detail = result["detail"]
         assert detail["device"] == "cpu"
         assert detail["service_times"] == "calibrated"
@@ -311,4 +356,8 @@ class TestDriverContract:
         assert detail["routing_precise_us"]["p99"] > 0
         assert detail["micro"]["index_lookup_us_per_chain"] > 0
         assert detail["micro"]["hash_chain_tok_s"] > 0
+        # The persistence regime is device-free: it must run (and hold
+        # warm >= cold) even with the chip unreachable.
+        restart = detail["indexer_restart"]
+        assert restart["warm_hit_rate"] >= restart["cold_hit_rate"]
         assert "CPU-detail fallback" in stderr

@@ -199,3 +199,96 @@ class TestHTTPService:
         finally:
             server.shutdown()
             indexer.shutdown()
+
+    def test_admin_snapshot_without_persistence_503(self, service):
+        _, base = service
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(base, "/admin/snapshot", {})
+        assert err.value.code == 503
+
+
+class TestPersistenceEndpoints:
+    @pytest.fixture()
+    def persistent_service(self, tmp_path):
+        from llm_d_kv_cache_manager_tpu.api.http_service import serve
+        from llm_d_kv_cache_manager_tpu.persistence import (
+            PersistenceConfig,
+            PersistenceManager,
+        )
+
+        tokenizer_dir = save_tokenizer_json(str(tmp_path), MODEL)
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=4),
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    workers=2, model_name=MODEL
+                ),
+            ),
+            tokenizer=LocalFastTokenizer(tokenizer_dir),
+        )
+        indexer.run()
+        manager = PersistenceManager(
+            PersistenceConfig(directory=str(tmp_path / "state"))
+        )
+        report = manager.recover(indexer.kv_block_index)
+        server = serve(
+            indexer,
+            host="127.0.0.1",
+            port=0,
+            persistence=manager,
+            recovery_report=report,
+        )
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        yield indexer, manager, base
+        server.shutdown()
+        manager.close()
+        indexer.shutdown()
+
+    def test_healthz_reports_recovery_and_persistence(
+        self, persistent_service
+    ):
+        indexer, manager, base = persistent_service
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            health = json.load(resp)
+        assert health["status"] == "ok"
+        assert health["recovery"]["status"] == "cold"  # empty dir
+        assert health["persistence"]["snapshot_path"] is None
+
+    def test_admin_snapshot_publishes_and_updates_healthz(
+        self, persistent_service
+    ):
+        indexer, manager, base = persistent_service
+        seed(indexer, PROMPT, "pod-a")
+        status, body = post(base, "/admin/snapshot", {})
+        assert status == 200
+        assert body["block_keys"] > 0
+        assert body["path"].endswith(".snap")
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            health = json.load(resp)
+        assert health["persistence"]["snapshot_path"] == body["path"]
+        assert health["persistence"]["snapshot_age_s"] is not None
+
+    def test_snapshot_then_recover_round_trip(self, persistent_service):
+        """The service-level warm restart: snapshot via the admin
+        endpoint, recover into a fresh indexer, identical scores."""
+        indexer, manager, base = persistent_service
+        seed(indexer, PROMPT, "pod-a")
+        post(base, "/admin/snapshot", {})
+        from llm_d_kv_cache_manager_tpu.persistence import recover
+
+        restored = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=4),
+            ),
+            tokenizer=indexer.tokenization_pool._tokenizer,
+        )
+        report = recover(restored.kv_block_index, manager.config)
+        assert report.status == "warm"
+        tokens = indexer.tokenization_pool.tokenize(PROMPT, MODEL, None)
+        keys = indexer.token_processor.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, tokens, MODEL
+        )
+        assert restored.kv_block_index.lookup(
+            keys
+        ) == indexer.kv_block_index.lookup(keys)
+        restored.shutdown()

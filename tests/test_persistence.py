@@ -1,0 +1,496 @@
+"""Crash-recovery suite for the persistence subsystem.
+
+Pins the guarantees docs/persistence.md promises: snapshot publish is
+atomic under a killed writer (a partial tmp file is never loaded), a
+journal with a truncated final record replays up to the last valid
+record, and a full snapshot+replay round-trip restores identical
+``lookup()`` results across the in-process backends.
+"""
+
+import os
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    ChunkedTokenDatabase,
+    PodEntry,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cbor_canonical import (
+    CborDecodeError,
+    decode_canonical,
+    encode_canonical,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    CostAwareIndexConfig,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import (
+    InstrumentedIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    Message,
+    Pool,
+    PoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.persistence import (
+    Journal,
+    PersistenceConfig,
+    PersistenceManager,
+    recover,
+)
+from llm_d_kv_cache_manager_tpu.persistence.journal import (
+    iter_journal,
+    list_segments,
+)
+from llm_d_kv_cache_manager_tpu.persistence.snapshot import (
+    SnapshotError,
+    load_latest_snapshot,
+    read_snapshot,
+    write_snapshot,
+)
+
+POD_A = PodEntry("pod-a", "hbm")
+POD_B = PodEntry("pod-b", "host")
+
+
+def make_index(kind: str):
+    if kind == "in_memory":
+        return InMemoryIndex(InMemoryIndexConfig(size=10_000))
+    if kind == "cost_aware":
+        return CostAwareMemoryIndex(
+            CostAwareIndexConfig(max_cost_bytes=64 * 1024 * 1024)
+        )
+    raise ValueError(kind)
+
+
+def populate(index) -> list:
+    """A small but non-trivial state: two pods, two tiers, a chain."""
+    index.add([1, 2, 3], [11, 12, 13], [POD_A])
+    index.add([2, 3], [12, 13], [POD_B])
+    index.add([4], [14], [PodEntry("pod-a", "host")])
+    index.evict(4, [PodEntry("pod-a", "host")])
+    return [11, 12, 13, 14, 99]  # 14 evicted, 99 never present
+
+
+class TestCborDecoder:
+    def test_roundtrip(self):
+        doc = [0, -5, 2**64 - 1, "pod", b"\x00\xff", [True, None, []]]
+        assert decode_canonical(encode_canonical(doc)) == doc
+
+    def test_truncation_raises(self):
+        data = encode_canonical([1, [2, 3], "abc"])
+        for cut in range(1, len(data)):
+            with pytest.raises(CborDecodeError):
+                decode_canonical(data[:cut])
+
+    def test_trailing_garbage_raises(self):
+        with pytest.raises(CborDecodeError):
+            decode_canonical(encode_canonical([1]) + b"\x00")
+
+
+@pytest.mark.parametrize("kind", ["in_memory", "cost_aware"])
+class TestSnapshotRoundTrip:
+    def test_dump_restore_identical_lookup(self, kind, tmp_path):
+        source = make_index(kind)
+        keys = populate(source)
+        block_entries, engine_map = source.dump_entries()
+        write_snapshot(str(tmp_path), {"pod-a": 7}, block_entries, engine_map)
+
+        restored = make_index(kind)
+        info, entries, emap = load_latest_snapshot(str(tmp_path))
+        restored.restore_entries(entries, emap)
+        assert restored.lookup(keys) == source.lookup(keys)
+        assert info.watermarks == {"pod-a": 7}
+        # Engine-key mappings survive too (parent resolution after
+        # recovery depends on them).
+        assert restored.get_request_key(1) == 11
+
+    def test_restore_respects_capacity_bounds(self, kind, tmp_path):
+        source = make_index(kind)
+        for i in range(50):
+            source.add([1000 + i], [2000 + i], [POD_A])
+        block_entries, engine_map = source.dump_entries()
+        if kind == "in_memory":
+            bounded = InMemoryIndex(InMemoryIndexConfig(size=10))
+        else:
+            # Budget for roughly a handful of keys.
+            bounded = CostAwareMemoryIndex(
+                CostAwareIndexConfig(max_cost_bytes=2000)
+            )
+        bounded.restore_entries(block_entries, engine_map)
+        found = bounded.lookup([2000 + i for i in range(50)])
+        assert 0 < len(found) < 50
+        # LRU-first dump order: the NEWEST keys are the survivors.
+        assert 2049 in found
+
+
+class TestSnapshotAtomicity:
+    def test_partial_tmp_file_never_loaded(self, tmp_path):
+        """A writer killed before the rename leaves only a .tmp file;
+        the loader must not even consider it."""
+        index = make_index("in_memory")
+        populate(index)
+        entries, emap = index.dump_entries()
+        info = write_snapshot(str(tmp_path), {}, entries, emap)
+        # Simulate a killed second writer: a half-written tmp file.
+        torn = os.path.join(
+            str(tmp_path), "snapshot-99999999999999999999.snap.tmp.123.4"
+        )
+        with open(torn, "wb") as handle:
+            handle.write(b"KVTPUSNP\x00\x01partial")
+        loaded_info, _, _ = load_latest_snapshot(str(tmp_path))
+        assert loaded_info.path == info.path
+
+    def test_torn_published_file_falls_back_to_previous(self, tmp_path):
+        index = make_index("in_memory")
+        populate(index)
+        entries, emap = index.dump_entries()
+        good = write_snapshot(str(tmp_path), {}, entries, emap)
+        newer = write_snapshot(
+            str(tmp_path), {}, entries, emap, retain=5
+        )
+        # Truncate the newer snapshot mid-body (torn write on a
+        # non-atomic filesystem / disk corruption).
+        size = os.path.getsize(newer.path)
+        with open(newer.path, "r+b") as handle:
+            handle.truncate(size - 10)
+        with pytest.raises(SnapshotError):
+            read_snapshot(newer.path)
+        loaded_info, loaded_entries, _ = load_latest_snapshot(
+            str(tmp_path)
+        )
+        assert loaded_info.path == good.path
+        assert len(loaded_entries) == len(entries)
+
+    def test_bad_magic_and_version_rejected(self, tmp_path):
+        path = os.path.join(str(tmp_path), "snapshot-1.snap")
+        with open(path, "wb") as handle:
+            handle.write(b"NOTASNAP" + b"\x00" * 14)
+        with pytest.raises(SnapshotError, match="magic"):
+            read_snapshot(path)
+        assert load_latest_snapshot(str(tmp_path)) is None
+
+    def test_retention_prunes_old_snapshots(self, tmp_path):
+        index = make_index("in_memory")
+        populate(index)
+        entries, emap = index.dump_entries()
+        for _ in range(4):
+            write_snapshot(str(tmp_path), {}, entries, emap, retain=2)
+        remaining = [
+            name
+            for name in os.listdir(str(tmp_path))
+            if name.endswith(".snap")
+        ]
+        assert len(remaining) == 2
+
+
+class TestJournal:
+    def test_torn_final_record_replays_prefix(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 1, [1], [11], [POD_A])
+        journal.record_add("pod-a", 2, [2], [12], [POD_A])
+        journal.record_evict("pod-a", 3, [1], [POD_A])
+        journal.close()
+        (_, path), = list_segments(str(tmp_path))
+        # Tear the tail mid-record: every prefix length must yield
+        # exactly the records whose framing fully survived.
+        full = open(path, "rb").read()
+        with open(path, "r+b") as handle:
+            handle.truncate(len(full) - 7)
+        records = list(iter_journal(str(tmp_path)))
+        assert [r.seq for r in records] == [1, 2]
+
+    def test_corrupt_crc_stops_replay(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 1, [1], [11], [POD_A])
+        journal.record_add("pod-a", 2, [2], [12], [POD_A])
+        journal.close()
+        (_, path), = list_segments(str(tmp_path))
+        data = bytearray(open(path, "rb").read())
+        data[-1] ^= 0xFF  # flip a byte in the LAST record's body
+        with open(path, "wb") as handle:
+            handle.write(bytes(data))
+        records = list(iter_journal(str(tmp_path)))
+        assert [r.seq for r in records] == [1]
+
+    def test_rotation_and_fresh_segment_on_reopen(self, tmp_path):
+        journal = Journal(str(tmp_path), segment_max_bytes=128)
+        for i in range(10):
+            journal.record_add("pod-a", i + 1, [i], [100 + i], [POD_A])
+        journal.close()
+        first_count = len(list_segments(str(tmp_path)))
+        assert first_count > 1  # rotation happened
+        # A new Journal never appends to a possibly-torn tail segment.
+        journal2 = Journal(str(tmp_path), segment_max_bytes=128)
+        journal2.record_add("pod-a", 11, [10], [110], [POD_A])
+        journal2.close()
+        assert len(list_segments(str(tmp_path))) == first_count + 1
+        assert [r.seq for r in iter_journal(str(tmp_path))] == list(
+            range(1, 12)
+        )
+
+    def test_watermarks_track_max_seq_per_pod(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 5, [1], [11], [POD_A])
+        journal.record_add("pod-b", 2, [2], [12], [POD_B])
+        journal.record_evict("pod-a", 7, [1], [POD_A])
+        assert journal.watermarks() == {"pod-a": 7, "pod-b": 2}
+        journal.close()
+
+
+class TestRecovery:
+    def test_cold_start_reports_cold(self, tmp_path):
+        index = make_index("in_memory")
+        report = recover(
+            index, PersistenceConfig(directory=str(tmp_path))
+        )
+        assert report.status == "cold"
+        assert report.block_keys_restored == 0
+
+    @pytest.mark.parametrize("kind", ["in_memory", "cost_aware"])
+    def test_snapshot_plus_replay_round_trip(self, kind, tmp_path):
+        """The acceptance round trip: snapshot at a boundary, more
+        traffic journaled after it, recovery = snapshot + tail."""
+        config = PersistenceConfig(directory=str(tmp_path))
+        manager = PersistenceManager(config)
+        source = make_index(kind)
+        keys = populate(source)
+        # Journal mirrors the applied ops (as the pool tap would).
+        manager.journal.record_add(
+            "pod-a", 1, [1, 2, 3], [11, 12, 13], [POD_A]
+        )
+        manager.journal.record_add("pod-b", 1, [2, 3], [12, 13], [POD_B])
+        manager.snapshot(source)
+        # Post-snapshot traffic lives only in the journal tail.
+        source.add([5], [15], [POD_B])
+        manager.journal.record_add("pod-b", 2, [5], [15], [POD_B])
+        source.evict(2, [POD_A])
+        manager.journal.record_evict("pod-a", 2, [2], [POD_A])
+        manager.close()
+
+        restored = make_index(kind)
+        report = recover(restored, config)
+        assert report.status == "warm"
+        assert report.records_replayed == 2
+        all_keys = keys + [15]
+        assert restored.lookup(all_keys) == source.lookup(all_keys)
+
+    def test_replay_skips_records_strictly_below_watermark(
+        self, tmp_path
+    ):
+        config = PersistenceConfig(directory=str(tmp_path))
+        manager = PersistenceManager(config)
+        index = make_index("in_memory")
+        index.add([1], [11], [POD_A])
+        manager.journal.record_add("pod-a", 4, [1], [11], [POD_A])
+        manager.snapshot(index)  # watermark pod-a=4, journal compacted
+        # Late duplicate delivery BELOW the watermark (e.g. a replayed
+        # publisher), a same-seq sibling AT the watermark (one
+        # message's events share a seq and can straddle the snapshot
+        # boundary — its effect may be missing from the dump, so it
+        # MUST replay), and genuinely new traffic above it.
+        manager.journal.record_add("pod-a", 3, [9], [19], [POD_A])
+        manager.journal.record_add("pod-a", 4, [5], [15], [POD_A])
+        manager.journal.record_add("pod-a", 6, [6], [16], [POD_A])
+        manager.close()
+
+        restored = make_index("in_memory")
+        report = recover(restored, config)
+        assert report.records_skipped == 1
+        assert report.records_replayed == 2
+        found = restored.lookup([11, 15, 16, 19])
+        assert set(found) == {11, 15, 16}
+
+    def test_failed_snapshot_publish_keeps_lag_truthful(
+        self, tmp_path, monkeypatch
+    ):
+        """A failed snapshot write (ENOSPC class) must not zero the
+        journal-lag telemetry: the replay cost it reports is real
+        until a snapshot actually publishes."""
+        import llm_d_kv_cache_manager_tpu.persistence.recovery as rec
+
+        config = PersistenceConfig(directory=str(tmp_path))
+        manager = PersistenceManager(config)
+        index = make_index("in_memory")
+        index.add([1], [11], [POD_A])
+        manager.journal.record_add("pod-a", 1, [1], [11], [POD_A])
+
+        def boom(*a, **kw):
+            raise OSError(28, "No space left on device")
+
+        monkeypatch.setattr(rec, "write_snapshot", boom)
+        with pytest.raises(OSError):
+            manager.snapshot(index)
+        assert manager.status()["journal_records_since_snapshot"] == 1
+        monkeypatch.undo()
+        manager.snapshot(index)
+        assert manager.status()["journal_records_since_snapshot"] == 0
+        manager.close()
+
+    def test_compaction_removes_covered_segments(self, tmp_path):
+        config = PersistenceConfig(
+            directory=str(tmp_path), journal_segment_max_bytes=128
+        )
+        manager = PersistenceManager(config)
+        index = make_index("in_memory")
+        for i in range(10):
+            index.add([i], [100 + i], [POD_A])
+            manager.journal.record_add(
+                "pod-a", i + 1, [i], [100 + i], [POD_A]
+            )
+        assert len(list_segments(config.journal_dir)) > 1
+        manager.snapshot(index)
+        # Everything below the boundary is covered by the snapshot.
+        assert list_segments(config.journal_dir) == []
+        manager.close()
+        restored = make_index("in_memory")
+        recover(restored, config)
+        keys = [100 + i for i in range(10)]
+        assert restored.lookup(keys) == index.lookup(keys)
+
+
+class TestBackendContractExtensions:
+    def test_instrumented_delegates(self):
+        inner = make_index("in_memory")
+        wrapped = InstrumentedIndex(inner)
+        wrapped.add([1], [11], [POD_A])
+        entries, emap = wrapped.dump_entries()
+        assert entries and emap
+        other = InstrumentedIndex(make_index("in_memory"))
+        assert other.restore_entries(entries, emap) == 1
+        assert other.lookup([11]) == inner.lookup([11])
+
+    def test_redis_backend_is_documented_noop(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+            RedisIndex,
+        )
+
+        # No server needed: the no-op must not touch the client.
+        dump = RedisIndex.dump_entries
+        restore = RedisIndex.restore_entries
+        assert dump(object()) == ([], [])
+        assert restore(object(), [(1, [POD_A])], [(1, 1)]) == 0
+        assert "no-op" in dump.__doc__
+
+
+class TestPoolJournalTap:
+    def test_applied_events_flow_to_journal_and_recover(self, tmp_path):
+        """End to end through the real wire path: msgpack BlockStored/
+        BlockRemoved -> sharded pool -> index apply -> journal tap ->
+        recovery into a fresh index with identical lookups."""
+        config = PersistenceConfig(directory=str(tmp_path))
+        manager = PersistenceManager(config)
+        index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = Pool(
+            index,
+            db,
+            PoolConfig(concurrency=2),
+            journal=manager.journal,
+        )
+        pool.start()
+
+        def deliver(pod, seq, *events):
+            batch = EventBatch(ts=1.0, events=list(events))
+            pool.add_task(
+                Message(
+                    topic=f"kv@{pod}@m",
+                    payload=batch.encode(),
+                    pod_identifier=pod,
+                    model_name="m",
+                    seq=seq,
+                )
+            )
+            pool.drain()
+
+        deliver(
+            "pod-a",
+            1,
+            BlockStored(
+                block_hashes=[101, 102],
+                parent_block_hash=None,
+                token_ids=[1, 2, 3, 4, 5, 6, 7, 8],
+                block_size=4,
+                medium="hbm",
+            ),
+        )
+        deliver("pod-a", 2, BlockRemoved(block_hashes=[102]))
+        pool.shutdown()
+        manager.close()
+
+        request_keys = db.tokens_to_kv_block_keys(
+            0, [1, 2, 3, 4, 5, 6, 7, 8], "m"
+        )
+        restored = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+        report = recover(restored, config)
+        assert report.records_replayed == 2
+        assert report.pods == ["pod-a"]
+        assert restored.lookup(request_keys) == index.lookup(request_keys)
+        # The stored-then-removed second block is absent in both.
+        assert request_keys[1] not in restored.lookup(request_keys)
+
+    def test_failed_apply_is_not_journaled(self, tmp_path):
+        """The tap sits AFTER the apply: an event whose parent cannot
+        be resolved (skipped by the digest) must leave no record."""
+        config = PersistenceConfig(directory=str(tmp_path))
+        manager = PersistenceManager(config)
+        index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = Pool(
+            index, db, PoolConfig(concurrency=1), journal=manager.journal
+        )
+        pool.start()
+        batch = EventBatch(
+            ts=1.0,
+            events=[
+                BlockStored(
+                    block_hashes=[7],
+                    parent_block_hash=999999,  # unknown parent: skipped
+                    token_ids=[1, 2, 3, 4],
+                    block_size=4,
+                )
+            ],
+        )
+        pool.add_task(
+            Message(
+                topic="kv@pod-a@m",
+                payload=batch.encode(),
+                pod_identifier="pod-a",
+                model_name="m",
+                seq=1,
+            )
+        )
+        pool.drain()
+        pool.shutdown()
+        manager.close()
+        assert list(iter_journal(config.journal_dir)) == []
+
+
+class TestManagerStatus:
+    def test_status_reflects_snapshot_and_lag(self, tmp_path):
+        config = PersistenceConfig(directory=str(tmp_path))
+        manager = PersistenceManager(config)
+        status = manager.status()
+        assert status["snapshot_path"] is None
+        index = make_index("in_memory")
+        index.add([1], [11], [POD_A])
+        manager.journal.record_add("pod-a", 1, [1], [11], [POD_A])
+        assert manager.status()["journal_records_since_snapshot"] == 1
+        manager.snapshot(index)
+        status = manager.status()
+        assert status["snapshot_path"]
+        assert status["snapshot_bytes"] > 0
+        assert status["journal_records_since_snapshot"] == 0
+        manager.close()

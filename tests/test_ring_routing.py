@@ -1,0 +1,63 @@
+"""Routing decision for ``ring_attention_sharded(impl="auto")``.
+
+The auto impl must pick the Pallas flash body only where the kernel
+runs (TPU mesh / explicit interpret) AND the per-step local K/V chunk
+fits the kernel's VMEM staging budget; otherwise the einsum body, which
+streams from HBM.  Pure-function tests (``resolve_auto_impl``) so they
+run on any host — the shard_map plumbing itself is covered by the
+model-level ring tests.
+"""
+
+from llm_d_kv_cache_manager_tpu.ops.flash_pallas import (
+    VMEM_KV_BUDGET_BYTES,
+    fits_vmem,
+)
+from llm_d_kv_cache_manager_tpu.ops.ring_attention import resolve_auto_impl
+
+HEAD_DIM = 128
+BF16 = 2
+
+
+def max_fitting_tokens() -> int:
+    """Largest local K/V chunk inside the staging budget at HEAD_DIM."""
+    tokens = VMEM_KV_BUDGET_BYTES // (2 * HEAD_DIM * BF16)
+    assert fits_vmem(tokens, HEAD_DIM, BF16)
+    assert not fits_vmem(tokens + 1, HEAD_DIM, BF16)
+    return tokens
+
+
+class TestResolveAutoImpl:
+    def test_tpu_within_budget_picks_flash(self):
+        assert (
+            resolve_auto_impl("tpu", 4096, HEAD_DIM, BF16) == "flash"
+        )
+
+    def test_tpu_over_budget_falls_back_to_einsum(self):
+        """The shape that used to lower (or spill) a too-large Pallas
+        staging block now routes to the streaming einsum body."""
+        over = max_fitting_tokens() + 1
+        assert resolve_auto_impl("tpu", over, HEAD_DIM, BF16) == "einsum"
+
+    def test_boundary_is_the_fits_vmem_bound(self):
+        at_bound = max_fitting_tokens()
+        assert (
+            resolve_auto_impl("tpu", at_bound, HEAD_DIM, BF16) == "flash"
+        )
+
+    def test_cpu_mesh_always_einsum(self):
+        assert resolve_auto_impl("cpu", 128, HEAD_DIM, BF16) == "einsum"
+
+    def test_interpret_forces_flash_regardless_of_budget(self):
+        """interpret=True is an explicit request to exercise the
+        Pallas kernel (no real VMEM involved): never silently resolve
+        it away, even past the budget."""
+        over = max_fitting_tokens() + 1
+        assert (
+            resolve_auto_impl("cpu", over, HEAD_DIM, BF16, interpret=True)
+            == "flash"
+        )
+
+    def test_wider_dtype_tightens_the_bound(self):
+        tokens = max_fitting_tokens()
+        # The same chunk in f32 doubles the staging bytes.
+        assert resolve_auto_impl("tpu", tokens, HEAD_DIM, 4) == "einsum"

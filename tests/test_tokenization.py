@@ -206,6 +206,44 @@ class TestTokenizationPool:
         assert ratio >= 0.8
         pool.shutdown()
 
+    def test_sync_miss_probes_store_exactly_once(
+        self, local_tokenizer_dir
+    ):
+        """The caller thread probes the prefix store before queueing;
+        a miss carries ``store_probed`` on the task so the worker does
+        NOT pay a second probe for the same prompt (one store read per
+        miss, not two)."""
+
+        class CountingStore(LRUTokenStore):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self.probes = 0
+
+            def find_longest_contained_tokens(self, prompt, model):
+                self.probes += 1
+                return super().find_longest_contained_tokens(
+                    prompt, model
+                )
+
+        store = CountingStore(LRUStoreConfig(block_size=16))
+        pool = TokenizationPool(
+            CountingTokenizer(LocalFastTokenizer(local_tokenizer_dir)),
+            store,
+            TokenizationPoolConfig(workers=1, model_name="test-model"),
+        )
+        prompt = "sphinx of black quartz judge my vow . " * 8
+        pool.tokenize(prompt)  # cold miss
+        assert store.probes == 1
+        # A warm repeat is served by the caller-side probe alone.
+        pool.tokenize(prompt)
+        assert store.probes == 2
+        # Fire-and-forget tasks were never pre-probed: the worker-side
+        # probe must still run for them (probe + hit, no new encode).
+        pool.enqueue_tokenization(prompt)
+        pool._queue.join()
+        assert store.probes == 3
+        pool.shutdown()
+
     def test_retries_then_fails(self):
         class AlwaysBroken:
             def type(self):
