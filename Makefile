@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint test unit-test e2e-test examples bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint test unit-test e2e-test examples bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -26,6 +26,13 @@ lint:
 			llm_d_kv_cache_manager_tpu/native/src/*.hpp; \
 	fi
 	$(PYTHON) hack/check_native_format.py
+	$(MAKE) kvlint
+
+# Project-invariant static analysis (hack/kvlint, stdlib-only; see
+# docs/static-analysis.md): lock discipline, tracer safety, canonical
+# serialization, blocking-in-async, swallowed errors.
+kvlint:
+	$(PYTHON) -m hack.kvlint llm_d_kv_cache_manager_tpu
 
 test: unit-test
 

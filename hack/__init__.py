@@ -1,0 +1,4 @@
+# Makes hack/ importable so `python -m hack.kvlint` works from the
+# repo root (the analyzer lives in hack/kvlint/).  Developer tooling
+# only — never shipped (pyproject packages.find includes only
+# llm_d_kv_cache_manager_tpu*).

@@ -1,0 +1,88 @@
+"""kvlint — project-invariant static analysis (stdlib ``ast``, no deps).
+
+Generic linters can't see this project's correctness contracts; these
+rules encode them (each in its own module, docs/static-analysis.md):
+
+* KV001 lock discipline — ``# guarded-by:`` attributes only touched
+  under their lock (kv001_locks)
+* KV002 tracer safety — no Python control flow / host calls on traced
+  values in ``ops/`` and ``models/`` (kv002_tracer)
+* KV003 canonical serialization — hashed/journaled bytes go through
+  ``kvblock/cbor_canonical`` only (kv003_serialization)
+* KV004 blocking-in-async — no sync sleep/socket/file I/O inside
+  ``async def`` (kv004_async)
+* KV005 swallowed errors — no bare/broad excepts that hide failures
+  in worker loops (kv005_except)
+
+CLI: ``python -m hack.kvlint [paths...]`` — exit 0 clean, 1 findings,
+2 usage/internal error.  Output: ``path:line: RULE: message``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from hack.kvlint import (
+    kv001_locks,
+    kv002_tracer,
+    kv003_serialization,
+    kv004_async,
+    kv005_except,
+)
+from hack.kvlint.base import Finding, SourceFile, SourceParseError
+
+RULES = (
+    kv001_locks,
+    kv002_tracer,
+    kv003_serialization,
+    kv004_async,
+    kv005_except,
+)
+RULE_IDS = tuple(rule.RULE for rule in RULES)
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted .py file list."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, names in os.walk(path):
+                dirs[:] = sorted(
+                    d
+                    for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        elif path.endswith(".py"):
+            out.append(path)
+    return out
+
+
+def check_file(
+    path: str, rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    with open(path, encoding="utf-8") as handle:
+        text = handle.read()
+    try:
+        source = SourceFile(path, text)
+    except SourceParseError as exc:
+        return [Finding(path, 0, "KV000", str(exc))]
+    findings: List[Finding] = []
+    for rule in RULES:
+        if rules and rule.RULE not in rules:
+            continue
+        findings.extend(rule.check(source))
+    findings.sort(key=lambda f: (f.line, f.rule, f.message))
+    return findings
+
+
+def check_paths(
+    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in collect_files(paths):
+        findings.extend(check_file(path, rules))
+    return findings

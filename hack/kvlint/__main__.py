@@ -1,0 +1,88 @@
+"""CLI: ``python -m hack.kvlint [paths...]`` — see package docstring.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.  Findings go to
+stdout as ``path:line: RULE: message`` (the format is pinned by a
+contract test); baseline/stale diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from hack.kvlint import RULE_IDS, check_paths
+from hack.kvlint import baseline as baseline_mod
+
+DEFAULT_PATHS = ("llm_d_kv_cache_manager_tpu",)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hack.kvlint",
+        description="Project-invariant static analysis (KV001-KV005).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=list(DEFAULT_PATHS),
+        help="files or directories (default: the package tree)",
+    )
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule subset, e.g. KV001,KV005",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=baseline_mod.DEFAULT_BASELINE,
+        help="baseline file of grandfathered findings",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline (report everything)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from current findings and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+        unknown = [r for r in rules if r not in RULE_IDS]
+        if unknown:
+            parser.error(f"unknown rule(s): {', '.join(unknown)}")
+
+    findings = check_paths(args.paths, rules)
+
+    if args.write_baseline:
+        count = baseline_mod.write(args.baseline, findings)
+        print(
+            f"kvlint: wrote {count} baseline entr"
+            f"{'y' if count == 1 else 'ies'} to {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    stale = []
+    if not args.no_baseline:
+        entries = baseline_mod.load(args.baseline)
+        findings, stale = baseline_mod.apply(findings, entries)
+
+    for finding in findings:
+        print(finding.format())
+    for entry in stale:
+        print(f"kvlint: stale baseline entry: {entry}", file=sys.stderr)
+    if findings:
+        print(
+            f"kvlint: {len(findings)} finding"
+            f"{'' if len(findings) == 1 else 's'}",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

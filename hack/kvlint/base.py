@@ -1,0 +1,132 @@
+"""Shared model for kvlint rules: parsed source, comments, suppression.
+
+Every rule sees a :class:`SourceFile` — the AST plus the comment map the
+AST drops (``ast`` has no comments; ``tokenize`` recovers them), which
+is where the project conventions live:
+
+* ``# guarded-by: <lock>`` declares a lock-guarded attribute (KV001)
+* ``# kvlint: caller-locked`` marks a method whose callers hold the lock
+* ``# kvlint: disable=KV001[,KV005]`` suppresses findings on that line
+  (or the line directly below it, for wrapped statements)
+
+Findings print as ``path:line: RULE: message`` — one per line, machine
+parseable (pinned by tests/test_kvlint.py's contract test).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+    def baseline_key(self) -> str:
+        """Line-number-free identity, so unrelated edits above a
+        grandfathered finding don't invalidate the baseline entry."""
+        return f"{self.path}: {self.rule}: {self.message}"
+
+
+_DISABLE_RE = re.compile(r"kvlint:\s*disable=([A-Z0-9,\s]+)")
+CALLER_LOCKED_MARK = "kvlint: caller-locked"
+
+
+class SourceParseError(Exception):
+    """The file could not be tokenized/parsed; reported as a finding."""
+
+
+class SourceFile:
+    """One parsed Python file: AST + comments + suppression map."""
+
+    def __init__(self, path: str, text: str) -> None:
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as exc:
+            raise SourceParseError(
+                f"syntax error: {exc.msg} (line {exc.lineno})"
+            ) from exc
+        # line -> (col, comment text) for every comment token.
+        self.comments: Dict[int, Tuple[int, str]] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(text).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = (tok.start[1], tok.string)
+        except tokenize.TokenError:  # pragma: no cover - parse succeeded
+            pass
+        self._disabled: Dict[int, Set[str]] = {}
+        for lineno, (_, comment) in self.comments.items():
+            match = _DISABLE_RE.search(comment)
+            if match:
+                self._disabled[lineno] = {
+                    rule.strip()
+                    for rule in match.group(1).split(",")
+                    if rule.strip()
+                }
+
+    def comment_on(self, lineno: int) -> Optional[str]:
+        entry = self.comments.get(lineno)
+        return entry[1] if entry else None
+
+    def code_before_comment(self, lineno: int) -> str:
+        """The source line with any trailing comment stripped."""
+        line = self.lines[lineno - 1] if lineno <= len(self.lines) else ""
+        entry = self.comments.get(lineno)
+        if entry and entry[0] <= len(line):
+            return line[: entry[0]]
+        return line
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        """``# kvlint: disable=RULE`` on the flagged line or the line
+        above it (wrapped statements report their first line)."""
+        for candidate in (lineno, lineno - 1):
+            rules = self._disabled.get(candidate)
+            if rules and rule in rules:
+                return True
+        return False
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_functions(tree: ast.AST):
+    """Every (Async)FunctionDef in the tree, outermost first."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def param_names(args: ast.arguments) -> List[str]:
+    names = [a.arg for a in args.posonlyargs]
+    names += [a.arg for a in args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names += [a.arg for a in args.kwonlyargs]
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names

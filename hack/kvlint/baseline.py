@@ -1,0 +1,83 @@
+"""Baseline file: intentionally-grandfathered kvlint findings.
+
+Format: one finding per line, ``path: RULE: message`` — the line number
+is deliberately omitted so unrelated edits above a grandfathered site
+don't invalidate its entry.  Lines starting with ``#`` are comments
+(use them to justify every entry); blank lines are ignored.
+
+Workflow (docs/static-analysis.md):
+
+* new violations fail the build — fix them, suppress with a justified
+  ``# kvlint: disable=KV00x``, or (last resort) baseline them with
+  ``python -m hack.kvlint --write-baseline``;
+* a baseline entry that no longer matches anything is reported as
+  stale (stderr) so the file shrinks monotonically toward empty.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import Dict, Iterable, List, Tuple
+
+from hack.kvlint.base import Finding
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.txt"
+)
+
+
+def load(path: str) -> Dict[str, int]:
+    """key -> grandfathered occurrence count.
+
+    Counted, not set-matched: one baselined swallowed-except must not
+    also grandfather a *second* identical finding added later to the
+    same file (same rule, same message, line numbers omitted).  A
+    duplicate line in the file grandfathers a second occurrence.
+    """
+    if not os.path.exists(path):
+        return {}
+    entries: Counter = Counter()
+    with open(path, encoding="utf-8") as handle:
+        for line in handle:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                entries[line] += 1
+    return dict(entries)
+
+
+def apply(
+    findings: Iterable[Finding], entries: Dict[str, int]
+) -> Tuple[List[Finding], List[str]]:
+    """(surviving findings, stale baseline entries).
+
+    Each baseline entry absorbs at most its counted occurrences; any
+    finding beyond that budget survives and fails the build."""
+    kept: List[Finding] = []
+    remaining = Counter(entries)
+    for finding in findings:
+        key = finding.baseline_key()
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+        else:
+            kept.append(finding)
+    stale = sorted(
+        key for key, count in remaining.items() if count > 0
+        for _ in range(count)
+    )
+    return kept, stale
+
+
+def write(path: str, findings: Iterable[Finding]) -> int:
+    keys = sorted(f.baseline_key() for f in findings)
+    with open(path, "w", encoding="utf-8") as handle:
+        handle.write(
+            "# kvlint baseline — grandfathered findings (justify each "
+            "entry;\n# see docs/static-analysis.md).  Regenerate with\n"
+            "#   python -m hack.kvlint --write-baseline\n"
+            "# One line per finding: a key occurring N times "
+            "grandfathers N occurrences.\n"
+        )
+        for key in keys:
+            handle.write(key + "\n")
+    return len(keys)

@@ -1,0 +1,157 @@
+"""KV001 — lock discipline for ``# guarded-by:`` annotated attributes.
+
+The Go reference gets its lock discipline checked by ``go vet`` and race
+builds; CPython threads get neither, so this rule enforces the
+declared-guard convention statically:
+
+    self._cost = 0  # guarded-by: _lock
+
+declares that ``self._cost`` may only be read or written
+
+* inside a ``with self._lock:`` block (any ``with``-able sync
+  primitive: Lock, RLock, Condition), or
+* in a method whose callers hold the lock — name ending ``_locked``,
+  or a ``# kvlint: caller-locked`` comment on its ``def`` line.
+
+``__init__`` is exempt (the object is not yet shared).  Nested
+functions (closures) are analyzed with an EMPTY held-lock set: a
+closure can outlive the ``with`` block that created it, so assuming it
+inherits the lock would be unsound.
+
+Scope limits (documented, deliberate): only ``self.<attr>`` accesses
+inside the declaring class are checked — foreign-object accesses
+(``other._data``) and module-level globals are out of scope, as is
+aliasing (``d = self._data`` then mutating ``d`` outside the lock
+defeats the rule; don't do that).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from hack.kvlint.base import CALLER_LOCKED_MARK, Finding, SourceFile
+
+RULE = "KV001"
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?:self\.)?([A-Za-z_]\w*)")
+_DECL_ATTR_RE = re.compile(r"self\.([A-Za-z_]\w*)\s*[:=]")
+
+
+def check(source: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(source.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(source, node))
+    return findings
+
+
+def _class_span(cls: ast.ClassDef) -> range:
+    end = cls.lineno
+    for node in ast.walk(cls):
+        end = max(end, getattr(node, "end_lineno", 0) or 0)
+    return range(cls.lineno, end + 1)
+
+
+def _collect_guards(source: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
+    """attr name -> guarding lock attr, from ``# guarded-by:`` comments
+    on ``self.<attr> = ...`` lines inside the class body."""
+    guards: Dict[str, str] = {}
+    for lineno in _class_span(cls):
+        comment = source.comment_on(lineno)
+        if not comment:
+            continue
+        match = _GUARDED_RE.search(comment)
+        if not match:
+            continue
+        decl = _DECL_ATTR_RE.search(source.code_before_comment(lineno))
+        if decl:
+            guards[decl.group(1)] = match.group(1)
+    return guards
+
+
+def _is_caller_locked(source: SourceFile, func: ast.AST) -> bool:
+    if func.name.endswith("_locked"):
+        return True
+    comment = source.comment_on(func.lineno)
+    return bool(comment and CALLER_LOCKED_MARK in comment)
+
+
+def _with_locks(node: ast.With) -> Set[str]:
+    """Lock attr names acquired by ``with self.<lock>[, ...]:``."""
+    locks: Set[str] = set()
+    for item in node.items:
+        expr = item.context_expr
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            locks.add(expr.attr)
+    return locks
+
+
+def _check_class(source: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    guards = _collect_guards(source, cls)
+    if not guards:
+        return []
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, ast.ClassDef):
+            return  # nested classes have their own guard sets
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                visit(item.context_expr, held)
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, held)
+            inner = held | _with_locks(node)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            # Closures may escape the guarded region; never inherit.
+            body = (
+                node.body
+                if isinstance(node.body, list)
+                else [node.body]
+            )
+            for stmt in body:
+                visit(stmt, set())
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in guards
+        ):
+            lock = guards[node.attr]
+            if lock not in held and not source.suppressed(
+                node.lineno, RULE
+            ):
+                findings.append(
+                    Finding(
+                        source.path,
+                        node.lineno,
+                        RULE,
+                        f"'self.{node.attr}' is guarded by "
+                        f"'self.{lock}' but accessed without holding "
+                        "it (wrap in `with self."
+                        f"{lock}:` or mark the method caller-locked)",
+                    )
+                )
+            # fall through: subscripts/attrs hang off this node
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if item.name == "__init__" or _is_caller_locked(source, item):
+            continue
+        for stmt in item.body:
+            visit(stmt, set())
+    return findings

@@ -1,0 +1,395 @@
+"""KV002 — tracer safety in ``ops/`` and ``models/``.
+
+Inside a traced function (``@jax.jit``-decorated, wrapped by
+``jax.jit(fn)``, or a kernel handed to ``pl.pallas_call`` — possibly
+through a ``functools.partial`` binding), Python control flow on traced
+values is a trace-time error or, worse, a silent specialization:
+
+* ``if``/``while``/``assert``/ternary on a value derived from a traced
+  parameter (``TracerBoolConversionError`` at best)
+* ``bool()``/``int()``/``float()``/``.item()``/``.tolist()`` on one
+* host-side nondeterminism in the traced body: ``random.*``,
+  ``np.random.*`` (jax.random is fine), ``time.*`` — baked in at trace
+  time, silently frozen across calls
+
+Taint model (single forward pass, intra-function): parameters are
+tainted except jit ``static_argnums``/``static_argnames`` and
+``functools.partial``-bound arguments; assignment propagates; shape
+metadata (``.shape``/``.dtype``/``.ndim``/``.size``) and ``len()`` are
+static and scrub taint.  Nested defs (scan/fori_loop bodies, pallas
+inner closures) inherit the enclosing taint and add their own params.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from hack.kvlint.base import (
+    Finding,
+    SourceFile,
+    dotted_name,
+    param_names,
+)
+
+RULE = "KV002"
+
+SCOPE_SEGMENTS = ("ops", "models")
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "sharding"}
+STATIC_CALLS = {"len", "isinstance", "type", "getattr", "hasattr"}
+_CAST_CALLS = {"bool", "int", "float"}
+_HOST_VALUE_METHODS = {"item", "tolist", "__bool__", "__float__"}
+# module-attribute prefixes that are nondeterministic on the host
+_NONDET_PREFIXES = (
+    "random.",
+    "np.random.",
+    "numpy.random.",
+    "time.",
+)
+
+
+def in_scope(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return any(seg in parts for seg in SCOPE_SEGMENTS)
+
+
+def _ends_with(name: Optional[str], suffix: str) -> bool:
+    return bool(name) and (name == suffix or name.endswith("." + suffix))
+
+
+def _static_from_jit_call(
+    call: ast.Call, params: Sequence[str]
+) -> Set[str]:
+    """static_argnums/static_argnames keywords -> static param names."""
+    static: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        values = (
+            kw.value.elts
+            if isinstance(kw.value, (ast.Tuple, ast.List))
+            else [kw.value]
+        )
+        for value in values:
+            if isinstance(value, ast.Constant):
+                if isinstance(value.value, str):
+                    static.add(value.value)
+                elif isinstance(value.value, int) and 0 <= value.value < len(
+                    params
+                ):
+                    static.add(params[value.value])
+    return static
+
+
+def _partial_bound(
+    call: ast.Call, params: Sequence[str]
+) -> Tuple[Optional[ast.AST], Set[str]]:
+    """For ``functools.partial(f, a, kw=...)``: (f node, bound names)."""
+    if not call.args:
+        return None, set()
+    bound: Set[str] = set()
+    for i, _ in enumerate(call.args[1:]):
+        if i < len(params):
+            bound.add(params[i])
+    for kw in call.keywords:
+        if kw.arg:
+            bound.add(kw.arg)
+    return call.args[0], bound
+
+
+class _TracedCollector:
+    """Find traced defs and their static parameter names."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.defs: Dict[str, ast.AST] = {}
+        self.assigns: Dict[str, ast.expr] = {}
+        for node in ast.walk(tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self.defs[node.name] = node
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if isinstance(target, ast.Name):
+                    self.assigns[target.id] = node.value
+        # def node -> static param-name set
+        self.traced: Dict[ast.AST, Set[str]] = {}
+        self._collect(tree)
+
+    def _mark(self, func: ast.AST, static: Set[str]) -> None:
+        if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            existing = self.traced.get(func)
+            self.traced[func] = (
+                static if existing is None else existing & static
+            )
+
+    def _resolve(
+        self,
+        expr: ast.AST,
+        extra_static: Set[str],
+        _seen: Optional[Set[str]] = None,
+    ) -> None:
+        """Mark the def a jit/pallas_call argument refers to."""
+        seen = _seen if _seen is not None else set()
+        if isinstance(expr, ast.Name):
+            if expr.id in seen:
+                return  # self-referential assignment chain
+            seen.add(expr.id)
+            if expr.id in self.defs:
+                self._mark(self.defs[expr.id], set(extra_static))
+            elif expr.id in self.assigns:
+                self._resolve(self.assigns[expr.id], extra_static, seen)
+        elif isinstance(expr, ast.Call):
+            func_name = dotted_name(expr.func)
+            if _ends_with(func_name, "partial"):
+                inner, bound = self._partial_target(expr)
+                if inner is not None:
+                    self._resolve(inner, extra_static | bound, seen)
+
+    def _partial_target(
+        self, call: ast.Call
+    ) -> Tuple[Optional[ast.AST], Set[str]]:
+        target = call.args[0] if call.args else None
+        params: Sequence[str] = []
+        if isinstance(target, ast.Name) and target.id in self.defs:
+            params = param_names(self.defs[target.id].args)
+        return _partial_bound(call, params)
+
+    def _collect(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                params = param_names(node.args)
+                for dec in node.decorator_list:
+                    static = self._decorator_static(dec, params)
+                    if static is not None:
+                        self._mark(node, static)
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if _ends_with(name, "jit") or _ends_with(
+                    name, "pallas_call"
+                ):
+                    if node.args:
+                        extra: Set[str] = set()
+                        if _ends_with(name, "jit"):
+                            # Resolve the target's params first so
+                            # positional static_argnums map to names
+                            # (jax.jit(f, static_argnums=(0,))).
+                            target = node.args[0]
+                            params: List[str] = []
+                            if (
+                                isinstance(target, ast.Name)
+                                and target.id in self.defs
+                            ):
+                                params = param_names(
+                                    self.defs[target.id].args
+                                )
+                            extra = _static_from_jit_call(node, params)
+                        self._resolve(node.args[0], extra)
+
+    def _decorator_static(
+        self, dec: ast.AST, params: Sequence[str]
+    ) -> Optional[Set[str]]:
+        """Static names if ``dec`` marks the function as jitted."""
+        name = dotted_name(dec)
+        if _ends_with(name, "jit"):
+            return set()
+        if isinstance(dec, ast.Call):
+            func_name = dotted_name(dec.func)
+            if _ends_with(func_name, "jit"):
+                return _static_from_jit_call(dec, params)
+            if _ends_with(func_name, "partial") and dec.args:
+                inner = dotted_name(dec.args[0])
+                if _ends_with(inner, "jit"):
+                    return _static_from_jit_call(dec, params)
+        return None
+
+
+def _expr_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """Does ``expr`` reference a tainted name (shape/len-scrubbed)?"""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                continue  # scrub: static metadata of a traced value
+            stack.append(node.value)
+            continue
+        if isinstance(node, ast.Call):
+            func_name = dotted_name(node.func)
+            if func_name in STATIC_CALLS:
+                continue  # len(x) etc. are trace-time constants
+            stack.extend(ast.iter_child_nodes(node))
+            continue
+        if isinstance(node, ast.Name):
+            if node.id in tainted:
+                return True
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _flag(
+    findings: List[Finding],
+    source: SourceFile,
+    lineno: int,
+    message: str,
+) -> None:
+    if not source.suppressed(lineno, RULE):
+        findings.append(Finding(source.path, lineno, RULE, message))
+
+
+def _check_traced_body(
+    source: SourceFile,
+    func: ast.AST,
+    static: Set[str],
+    findings: List[Finding],
+    inherited: Optional[Set[str]] = None,
+) -> None:
+    tainted: Set[str] = set(inherited or set())
+    tainted |= set(param_names(func.args)) - static
+
+    def assign(target: ast.AST, is_tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if is_tainted:
+                tainted.add(target.id)
+            else:
+                tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                assign(elt, is_tainted)
+        elif isinstance(target, ast.Starred):
+            assign(target.value, is_tainted)
+
+    def check_call(node: ast.Call) -> None:
+        func_name = dotted_name(node.func)
+        if func_name:
+            for prefix in _NONDET_PREFIXES:
+                if func_name.startswith(prefix):
+                    _flag(
+                        findings,
+                        source,
+                        node.lineno,
+                        f"host-side '{func_name}' inside a traced "
+                        "function is frozen at trace time (use "
+                        "jax.random / pass values in)",
+                    )
+                    return
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _CAST_CALLS
+            and any(_expr_tainted(a, tainted) for a in node.args)
+        ):
+            _flag(
+                findings,
+                source,
+                node.lineno,
+                f"'{node.func.id}()' on a traced value forces "
+                "concretization inside jit",
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_VALUE_METHODS
+            and _expr_tainted(node.func.value, tainted)
+        ):
+            _flag(
+                findings,
+                source,
+                node.lineno,
+                f"'.{node.func.attr}()' on a traced value forces a "
+                "device sync inside jit",
+            )
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # scan/fori_loop/cond bodies: params traced, closure taint
+            # inherited.
+            _check_traced_body(
+                source, node, set(), findings, inherited=tainted
+            )
+            return
+        if isinstance(node, ast.Lambda):
+            inner = set(tainted) | set(param_names(node.args))
+            if isinstance(node.body, ast.IfExp) and _expr_tainted(
+                node.body.test, inner
+            ):
+                _flag(
+                    findings,
+                    source,
+                    node.lineno,
+                    "conditional on a traced value (use jnp.where / "
+                    "lax.cond)",
+                )
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            if _expr_tainted(node.test, tainted):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                _flag(
+                    findings,
+                    source,
+                    node.lineno,
+                    f"'{kind}' on a traced value (use jnp.where / "
+                    "lax.cond / lax.while_loop)",
+                )
+        elif isinstance(node, ast.IfExp):
+            if _expr_tainted(node.test, tainted):
+                _flag(
+                    findings,
+                    source,
+                    node.lineno,
+                    "ternary on a traced value (use jnp.where)",
+                )
+        elif isinstance(node, ast.Assert):
+            if _expr_tainted(node.test, tainted):
+                _flag(
+                    findings,
+                    source,
+                    node.lineno,
+                    "assert on a traced value (use "
+                    "checkify / debug.check)",
+                )
+        elif isinstance(node, ast.Call):
+            check_call(node)
+        elif isinstance(node, ast.Assign):
+            is_tainted = _expr_tainted(node.value, tainted)
+            visit_children(node.value)
+            for target in node.targets:
+                assign(target, is_tainted)
+            return
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if node.value is not None:
+                is_tainted = _expr_tainted(node.value, tainted)
+                visit_children(node.value)
+                if isinstance(node, ast.AugAssign):
+                    is_tainted = is_tainted or _expr_tainted(
+                        node.target, tainted
+                    )
+                assign(node.target, is_tainted)
+            return
+        visit_children(node)
+
+    def visit_children(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in func.body:
+        visit(stmt)
+
+
+def check(source: SourceFile) -> List[Finding]:
+    if not in_scope(source.path):
+        return []
+    findings: List[Finding] = []
+    collector = _TracedCollector(source.tree)
+    for func, static in collector.traced.items():
+        _check_traced_body(source, func, static, findings)
+    # de-dup (a def can be both decorated and partial-wrapped)
+    seen: Set[Tuple[int, str]] = set()
+    unique: List[Finding] = []
+    for finding in findings:
+        key = (finding.line, finding.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(finding)
+    return unique

@@ -1,0 +1,99 @@
+"""KV003 — canonical serialization in hashing/persistence paths.
+
+The cross-fleet block-hash contract (PAPER.md: exact hash parity with
+the reference indexer) and the durability formats of ``persistence/``
+both require *deterministic* bytes: everything hashed or written to
+disk must go through ``kvblock/cbor_canonical.py`` (RFC 8949 §4.2.1
+core deterministic encoding).  A stray ``msgpack.packb`` or
+``cbor2.dumps`` in those paths silently breaks hash parity (map order,
+float forms, indefinite lengths); ``pickle`` additionally executes
+arbitrary code on load, so it is banned everywhere.
+
+* ``pickle``/``cPickle``/``dill``/``shelve``/``marshal``: flagged in
+  every analyzed file (import or call).
+* ``msgpack``/``cbor2``/``cbor``/``json`` **in canonical scopes**
+  (``kvcache/``, ``persistence/``, ``offload/``, ``scheduler/``):
+  flagged outside ``cbor_canonical.py``.  ``json`` is included because
+  its output is not canonical (dict order, whitespace, float repr) —
+  the HTTP/API layer is out of scope and may use it freely.
+
+``kvevents/`` is deliberately NOT a canonical scope: the wire format IS
+msgpack (vLLM's publisher owns that contract, events.py decodes it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from hack.kvlint.base import Finding, SourceFile, dotted_name
+
+RULE = "KV003"
+
+BANNED_EVERYWHERE = {"pickle", "cPickle", "dill", "shelve", "marshal"}
+NONCANONICAL = {"msgpack", "cbor2", "cbor", "json"}
+CANONICAL_SCOPE_SEGMENTS = (
+    "kvcache",
+    "persistence",
+    "offload",
+    "scheduler",
+)
+ALLOWED_BASENAMES = ("cbor_canonical.py",)
+
+
+def _in_canonical_scope(path: str) -> bool:
+    normalized = path.replace("\\", "/")
+    if normalized.endswith(ALLOWED_BASENAMES):
+        return False
+    parts = normalized.split("/")
+    return any(seg in parts for seg in CANONICAL_SCOPE_SEGMENTS)
+
+
+def _root(module: Optional[str]) -> str:
+    return (module or "").split(".", 1)[0]
+
+
+def check(source: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    canonical = _in_canonical_scope(source.path)
+
+    def flag(lineno: int, module: str, what: str) -> None:
+        if source.suppressed(lineno, RULE):
+            return
+        if module in BANNED_EVERYWHERE:
+            message = (
+                f"'{what}': {module} is banned (non-deterministic "
+                "and/or code-executing); use kvblock/cbor_canonical "
+                "or an explicit format"
+            )
+        else:
+            message = (
+                f"'{what}': non-canonical serializer in a "
+                "hashing/persistence path; hashed or journaled bytes "
+                "must go through kvblock/cbor_canonical"
+            )
+        findings.append(Finding(source.path, lineno, RULE, message))
+
+    def is_banned(module: str) -> bool:
+        return module in BANNED_EVERYWHERE or (
+            canonical and module in NONCANONICAL
+        )
+
+    for node in ast.walk(source.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = _root(alias.name)
+                if is_banned(root):
+                    flag(node.lineno, root, f"import {alias.name}")
+        elif isinstance(node, ast.ImportFrom):
+            root = _root(node.module)
+            if node.level == 0 and is_banned(root):
+                flag(node.lineno, root, f"from {node.module} import ...")
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            root = _root(name)
+            if "." in name and is_banned(root):
+                flag(node.lineno, root, f"{name}(...)")
+    return findings

@@ -1,0 +1,118 @@
+"""KV004 — blocking calls inside ``async def``.
+
+One blocking call inside a coroutine stalls the whole event loop —
+every in-flight request, not just the offending one.  Flagged inside
+``async def`` bodies (nested sync ``def``s are skipped: they may be
+shipped to a thread pool via ``to_thread``/``run_in_executor``):
+
+* ``time.sleep`` (use ``asyncio.sleep``)
+* ``open()`` and ``os``-level file I/O
+* synchronous sockets: ``socket.*`` constructors, ``.recv``/
+  ``.recv_multipart``/``.sendall``/``.accept`` method calls
+* ``subprocess.run/call/check_*`` (use ``asyncio.create_subprocess_*``)
+* ``urllib.request.urlopen`` / ``requests.*``
+
+Deliberately NOT name-matched: ``.join``/``.wait``/``.result`` —
+``', '.join(...)`` and ``os.path.join`` are idiomatic and an AST
+cannot tell a str from a Thread; a name-only match would make the
+hard gate fire on legitimate code.  Blocking waits on futures inside
+coroutines are left to review.
+
+The repo's API surface is currently thread-based (stdlib http.server,
+gRPC sync stubs), so this rule mostly protects *future* async code —
+it exists so the first coroutine added to ``api/`` or ``kvevents/``
+inherits the discipline from day one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from hack.kvlint.base import Finding, SourceFile, dotted_name
+
+RULE = "KV004"
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "socket.create_connection": "use asyncio streams",
+    "socket.socket": "use asyncio streams",
+    "urllib.request.urlopen": "use an async HTTP client",
+    "subprocess.run": "use asyncio.create_subprocess_exec",
+    "subprocess.call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_output": "use asyncio.create_subprocess_exec",
+    "os.system": "use asyncio.create_subprocess_shell",
+}
+_BLOCKING_ROOTS = {"requests": "use an async HTTP client"}
+# Socket-specific names only: generic wait-ish names (join, wait,
+# result) collide with str.join / os.path.join etc. — see module
+# docstring.
+_BLOCKING_METHODS = {
+    "recv": "sync socket read",
+    "recv_multipart": "sync socket read",
+    "sendall": "sync socket write",
+    "accept": "sync socket accept",
+}
+_BLOCKING_NAMES = {"open": "use a thread (asyncio.to_thread) for file I/O"}
+
+
+def check(source: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def flag(lineno: int, what: str, hint: str) -> None:
+        if not source.suppressed(lineno, RULE):
+            findings.append(
+                Finding(
+                    source.path,
+                    lineno,
+                    RULE,
+                    f"blocking '{what}' inside async def ({hint})",
+                )
+            )
+
+    def check_call(node: ast.Call, awaited: bool) -> None:
+        if awaited:
+            return
+        name = dotted_name(node.func)
+        if name:
+            if name in _BLOCKING_DOTTED:
+                flag(node.lineno, name, _BLOCKING_DOTTED[name])
+                return
+            root = name.split(".", 1)[0]
+            if root in _BLOCKING_ROOTS and "." in name:
+                flag(node.lineno, name, _BLOCKING_ROOTS[root])
+                return
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _BLOCKING_NAMES
+        ):
+            flag(node.lineno, node.func.id, _BLOCKING_NAMES[node.func.id])
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _BLOCKING_METHODS
+        ):
+            flag(
+                node.lineno,
+                f".{node.func.attr}(...)",
+                _BLOCKING_METHODS[node.func.attr],
+            )
+
+    def visit(node: ast.AST, parent_await: Optional[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+            return  # sync helper: may legitimately run in a thread
+        if isinstance(node, ast.AsyncFunctionDef):
+            return  # nested coroutine: the outer walk visits it itself
+        if isinstance(node, ast.Await):
+            visit(node.value, node.value)
+            return
+        if isinstance(node, ast.Call):
+            check_call(node, awaited=node is parent_await)
+        for child in ast.iter_child_nodes(node):
+            visit(child, parent_await)
+
+    for node in ast.walk(source.tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            for stmt in node.body:
+                visit(stmt, None)
+    return findings

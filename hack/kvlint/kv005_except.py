@@ -1,0 +1,97 @@
+"""KV005 — bare excepts and silently-swallowed broad exceptions.
+
+Event and worker loops must survive bad input — but surviving
+*silently* turns every bug into a missing-data mystery (an index that
+quietly stops updating is worse than one that crashes).  Flagged:
+
+* ``except:`` (bare) — anywhere; it catches ``KeyboardInterrupt`` and
+  ``SystemExit`` too, wedging shutdown.
+* ``except Exception:`` / ``except BaseException:`` (alone or in a
+  tuple) whose body only ``pass``-es / ``continue``-s / ``return``-s
+  nothing — the error is swallowed with no log, no metric, no state.
+
+Any other statement in the handler body (a logging call, a metric
+increment, a fallback assignment, a ``raise``) counts as handling.
+Narrow-exception swallows (``except queue.Full: pass``) are control
+flow, not error hiding, and are not flagged.  ``__del__`` bodies are
+exempt: logging during interpreter teardown can itself raise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from hack.kvlint.base import Finding, SourceFile
+
+RULE = "KV005"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node: ast.AST) -> bool:
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(elt) for elt in type_node.elts)
+    return False
+
+
+def _swallows(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+            stmt.value is None
+            or (
+                isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is None
+            )
+        ):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def check(source: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    # Map handlers to their enclosing function (for the __del__ carve-out).
+    enclosing = {}
+    for func in ast.walk(source.tree):
+        if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for node in ast.walk(func):
+                if isinstance(node, ast.ExceptHandler):
+                    enclosing[node] = func.name  # innermost wins (walk order)
+
+    for node in ast.walk(source.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if enclosing.get(node) == "__del__":
+            continue
+        if source.suppressed(node.lineno, RULE):
+            continue
+        if node.type is None:
+            findings.append(
+                Finding(
+                    source.path,
+                    node.lineno,
+                    RULE,
+                    "bare 'except:' catches KeyboardInterrupt/"
+                    "SystemExit; catch Exception (and log) at most",
+                )
+            )
+        elif _is_broad(node.type) and _swallows(node.body):
+            findings.append(
+                Finding(
+                    source.path,
+                    node.lineno,
+                    RULE,
+                    "broad except swallows the error silently; log "
+                    "with context (or narrow the exception type)",
+                )
+            )
+    return findings

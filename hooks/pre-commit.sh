@@ -9,6 +9,9 @@ cd "$(git rev-parse --show-toplevel)"
 echo "-> lint"
 make lint
 
+echo "-> kvlint (project invariants)"
+make kvlint
+
 echo "-> tests"
 make test
 

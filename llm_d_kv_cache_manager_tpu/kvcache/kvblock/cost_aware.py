@@ -38,10 +38,10 @@ class CostAwareMemoryIndex(Index):
         self.config = config or CostAwareIndexConfig()
         self._lock = threading.Lock()
         # request_key -> OrderedDict[PodEntry, cost]; outer dict is LRU.
-        self._data: "OrderedDict[int, OrderedDict]" = OrderedDict()
-        self._engine_to_request: Dict[int, int] = {}
-        self._request_to_engines: Dict[int, Set[int]] = {}
-        self._cost = 0
+        self._data: "OrderedDict[int, OrderedDict]" = OrderedDict()  # guarded-by: _lock
+        self._engine_to_request: Dict[int, int] = {}  # guarded-by: _lock
+        self._request_to_engines: Dict[int, Set[int]] = {}  # guarded-by: _lock
+        self._cost = 0  # guarded-by: _lock
 
     @property
     def resident_cost_bytes(self) -> int:
@@ -54,6 +54,33 @@ class CostAwareMemoryIndex(Index):
             self._cost -= _KEY_OVERHEAD + sum(pods.values())
             for engine_key in self._request_to_engines.pop(key, ()):  # type: ignore[arg-type]
                 self._engine_to_request.pop(engine_key, None)
+
+    def _admit_locked(
+        self, request_key: int, entries: Sequence[PodEntry]
+    ) -> None:
+        """Shared admission path for add() and restore_entries():
+        get-or-create the key's pod map, charge per-entry costs,
+        refresh recency, and trim to pod_cache_size — the single place
+        the cost accounting lives, so live adds and recovery restores
+        can never drift apart."""
+        pods = self._data.get(request_key)
+        if pods is None:
+            pods = OrderedDict()
+            self._data[request_key] = pods
+            self._cost += _KEY_OVERHEAD
+        else:
+            self._data.move_to_end(request_key)
+        for entry in entries:
+            if entry not in pods:
+                cost = _entry_cost(entry)
+                pods[entry] = cost
+                self._cost += cost
+            else:
+                pods.move_to_end(entry)
+        # Bound pods per key like the in-memory backend.
+        while len(pods) > self.config.pod_cache_size:
+            _, cost = pods.popitem(last=False)
+            self._cost -= cost
 
     def lookup(
         self,
@@ -98,24 +125,7 @@ class CostAwareMemoryIndex(Index):
                 self._request_to_engines.setdefault(request_key, set()).add(
                     engine_key
                 )
-                pods = self._data.get(request_key)
-                if pods is None:
-                    pods = OrderedDict()
-                    self._data[request_key] = pods
-                    self._cost += _KEY_OVERHEAD
-                else:
-                    self._data.move_to_end(request_key)
-                for entry in entries:
-                    if entry not in pods:
-                        cost = _entry_cost(entry)
-                        pods[entry] = cost
-                        self._cost += cost
-                    else:
-                        pods.move_to_end(entry)
-                # Bound pods per key like the in-memory backend.
-                while len(pods) > self.config.pod_cache_size:
-                    _, cost = pods.popitem(last=False)
-                    self._cost -= cost
+                self._admit_locked(request_key, entries)
             self._evict_to_budget_locked()
 
     def evict(self, engine_key: int, entries: Sequence[PodEntry]) -> None:
@@ -167,23 +177,7 @@ class CostAwareMemoryIndex(Index):
             for request_key, entries in block_entries:
                 if not entries:
                     continue
-                pods = self._data.get(request_key)
-                if pods is None:
-                    pods = OrderedDict()
-                    self._data[request_key] = pods
-                    self._cost += _KEY_OVERHEAD
-                else:
-                    self._data.move_to_end(request_key)
-                for entry in entries:
-                    if entry not in pods:
-                        cost = _entry_cost(entry)
-                        pods[entry] = cost
-                        self._cost += cost
-                    else:
-                        pods.move_to_end(entry)
-                while len(pods) > self.config.pod_cache_size:
-                    _, cost = pods.popitem(last=False)
-                    self._cost -= cost
+                self._admit_locked(request_key, entries)
                 restored += 1
             for engine_key, request_key in engine_map:
                 self._engine_to_request[engine_key] = request_key

@@ -123,8 +123,8 @@ class Pool:
             queue.Queue(maxsize=self.config.max_queue_depth)
             for _ in range(self.config.concurrency)
         ]
-        self._threads: List[threading.Thread] = []
-        self._started = False
+        self._threads: List[threading.Thread] = []  # guarded-by: _lock
+        self._started = False  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def start(self) -> None:
@@ -181,7 +181,13 @@ class Pool:
                 try:
                     q.put_nowait(None)
                 except queue.Full:
-                    pass  # thread join below has a timeout; never block
+                    # Never block here; the thread join in shutdown()
+                    # has a timeout, so a lost sentinel only delays it.
+                    logger.warning(
+                        "shard %d full while restoring the shutdown "
+                        "sentinel; worker exit may be delayed",
+                        shard,
+                    )
                 METRICS.kvevents_dropped.labels(reason="shutdown").inc()
                 return
             METRICS.kvevents_dropped.labels(reason="queue_full").inc()
@@ -228,7 +234,14 @@ class Pool:
         try:
             batch = decode_event_batch(message.payload)
         except EventDecodeError as exc:
-            logger.debug("dropping poison-pill message: %s", exc)
+            # Data loss, not noise: this pod's cache state is now stale
+            # until its next re-store event.
+            logger.warning(
+                "dropping poison-pill message from pod %s (topic %s): %s",
+                message.pod_identifier,
+                message.topic,
+                exc,
+            )
             return
 
         for raw_event in batch.events:

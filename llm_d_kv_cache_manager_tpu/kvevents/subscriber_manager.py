@@ -35,7 +35,7 @@ class SubscriberManager:
         self._context = context
         self._bind = bind
         self._lock = threading.Lock()
-        self._subscribers: Dict[str, ZMQSubscriber] = {}
+        self._subscribers: Dict[str, ZMQSubscriber] = {}  # guarded-by: _lock
 
     def ensure_subscriber(
         self,

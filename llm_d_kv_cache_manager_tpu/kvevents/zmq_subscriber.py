@@ -148,18 +148,33 @@ class ZMQSubscriber:
                 )
 
     def _parse_message(self, parts) -> Optional[Message]:
+        # Dropped frames are event loss (stale scores for that pod
+        # until re-store), so every drop path logs at warning with
+        # enough context to find the misbehaving publisher.
         if len(parts) != 3:
-            logger.debug("dropping %d-part message", len(parts))
+            logger.warning(
+                "dropping %d-part message from %s (want [topic, seq, "
+                "payload])",
+                len(parts),
+                self.config.endpoint,
+            )
             return None
         topic_raw, seq_raw, payload = parts
         try:
             topic = topic_raw.decode()
         except UnicodeDecodeError:
-            logger.debug("dropping message with undecodable topic")
+            logger.warning(
+                "dropping message with undecodable topic from %s",
+                self.config.endpoint,
+            )
             return None
         parsed = parse_topic(topic)
         if parsed is None:
-            logger.debug("dropping message with malformed topic %r", topic)
+            logger.warning(
+                "dropping message with malformed topic %r from %s",
+                topic,
+                self.config.endpoint,
+            )
             return None
         pod_id, model = parsed
 

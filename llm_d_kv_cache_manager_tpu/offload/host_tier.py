@@ -42,15 +42,16 @@ class HostTierCache:
     ) -> None:
         self.max_bytes = max_bytes
         self._on_evict = on_evict
-        self._entries: "OrderedDict[int, np.ndarray]" = OrderedDict()
-        self._bytes = 0
+        self._entries: "OrderedDict[int, np.ndarray]" = OrderedDict()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
 
     @property
     def resident_bytes(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def put(self, file_hash: int, group: np.ndarray) -> bool:
         """Insert/refresh a group; oldest entries fall off the budget.

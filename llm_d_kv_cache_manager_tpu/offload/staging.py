@@ -28,7 +28,7 @@ class StagingBudget:
         if max_bytes <= 0:
             raise ValueError(f"max_bytes must be positive, got {max_bytes}")
         self.max_bytes = max_bytes
-        self._in_flight = 0
+        self._in_flight = 0  # guarded-by: _cond
         self._cond = threading.Condition()
 
     @property

@@ -330,7 +330,7 @@ class CompletionRouter:
 
     def __init__(self, engine: OffloadEngine) -> None:
         self.engine = engine
-        self._unclaimed: Dict[int, JobStatus] = {}
+        self._unclaimed: Dict[int, JobStatus] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def drain(self, owned_ids) -> List[Tuple[int, JobStatus]]:

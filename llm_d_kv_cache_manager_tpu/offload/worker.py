@@ -210,6 +210,16 @@ class DeviceToStorageHandler(_HandlerBase):
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
         self._budget_release(job_id)
         hashes, nbytes = self._job_hashes.pop(job_id, (None, 0))
+        if hashes is None:
+            # A completion this handler never submitted (or one already
+            # harvested) points at connector routing bugs — the store
+            # event for those blocks will never fire.  Never silent.
+            logger.warning(
+                "store completion for unknown job %d (status %s); "
+                "no event will be published",
+                job_id,
+                status.name,
+            )
         METRICS.offload_jobs.labels("store", status.name.lower()).inc()
         if status != JobStatus.SUCCEEDED:
             return status
@@ -280,7 +290,18 @@ class StorageToDeviceHandler(_HandlerBase):
         self._budget_release(job_id)
         pending = self._pending.pop(job_id, None)
         METRICS.offload_jobs.labels("load", status.name.lower()).inc()
-        if pending is None or status != JobStatus.SUCCEEDED:
+        if pending is None:
+            # An unknown load completion means the scatter for those
+            # blocks never runs — the pool is silently missing data the
+            # caller believes was paged in.  Never silent.
+            logger.warning(
+                "load completion for unknown job %d (status %s); "
+                "scatter skipped",
+                job_id,
+                status.name,
+            )
+            return status
+        if status != JobStatus.SUCCEEDED:
             return status
         block_ids, buffers = pending
         host = np.concatenate([np.moveaxis(b, 0, 1) for b in buffers], axis=1)

@@ -118,7 +118,10 @@ def mesh_is_active() -> bool:
         abstract = jax.sharding.get_abstract_mesh()
         if abstract is not None and not getattr(abstract, "empty", True):
             return True
-    except Exception:  # noqa: BLE001 - API drift across jax versions
+    except Exception:  # noqa: BLE001 API drift; kvlint: disable=KV005
+        # Capability probe: absence of the new-style API is an expected
+        # state on older jax, not an error — fall through to the legacy
+        # probe (a log here would fire on every trace).
         pass
     try:
         # ``with mesh:`` still routes through the legacy thread-resources

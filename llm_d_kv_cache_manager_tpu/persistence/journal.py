@@ -208,11 +208,11 @@ class Journal:
         os.makedirs(directory, exist_ok=True)
         existing = list_segments(directory)
         # Never append to a segment a dead process may have torn.
-        self._segment_id = (existing[-1][0] + 1) if existing else 0
-        self._handle = None
-        self._segment_bytes = 0
-        self._watermarks: Dict[str, int] = {}
-        self._records_since_snapshot = 0
+        self._segment_id = (existing[-1][0] + 1) if existing else 0  # guarded-by: _lock
+        self._handle = None  # guarded-by: _lock
+        self._segment_bytes = 0  # guarded-by: _lock
+        self._watermarks: Dict[str, int] = {}  # guarded-by: _lock
+        self._records_since_snapshot = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- append path ---------------------------------------------------
@@ -274,12 +274,13 @@ class Journal:
             ):
                 self._watermarks[record.pod_identifier] = record.seq
             self._records_since_snapshot += 1
+            lag = self._records_since_snapshot
             if self._segment_bytes >= self.segment_max_bytes:
                 self._rotate_locked()
         METRICS.persistence_journal_records.labels(
             op="add" if record.op == OP_ADD else "evict"
         ).inc()
-        METRICS.persistence_journal_lag.set(self._records_since_snapshot)
+        METRICS.persistence_journal_lag.set(lag)
 
     def _ensure_segment_locked(self):
         if self._handle is None:

@@ -194,7 +194,11 @@ class PersistenceManager:
             fsync=config.journal_fsync,
         )
         self._snapshot_lock = threading.Lock()
-        self.last_snapshot: Optional[SnapshotInfo] = None
+        # Separate from _snapshot_lock (held across the whole
+        # dump+fsync): /healthz reads must never block on a slow
+        # snapshot publish.
+        self._info_lock = threading.Lock()
+        self.last_snapshot: Optional[SnapshotInfo] = None  # guarded-by: _info_lock
 
     def recover(self, index: Index) -> RecoveryReport:
         """Run recovery into ``index``.
@@ -228,7 +232,8 @@ class PersistenceManager:
             )
             self.journal.compact_before(boundary)
             self.journal.mark_snapshot_published(covered)
-            self.last_snapshot = info
+            with self._info_lock:
+                self.last_snapshot = info
         METRICS.persistence_snapshot_timestamp.set(info.created_ns / 1e9)
         METRICS.persistence_snapshot_bytes.set(info.size_bytes)
         logger.info(
@@ -241,7 +246,8 @@ class PersistenceManager:
 
     def status(self) -> dict:
         """Health-endpoint view: snapshot age + journal lag."""
-        info = self.last_snapshot
+        with self._info_lock:
+            info = self.last_snapshot
         return {
             "snapshot_path": info.path if info else None,
             "snapshot_age_s": (

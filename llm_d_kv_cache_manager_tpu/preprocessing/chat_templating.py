@@ -39,7 +39,7 @@ class ChatTemplatingProcessor:
     """Renders chat conversations to prompt strings via transformers."""
 
     def __init__(self) -> None:
-        self._tokenizers: Dict[str, Any] = {}
+        self._tokenizers: Dict[str, Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def tokenizer_key(

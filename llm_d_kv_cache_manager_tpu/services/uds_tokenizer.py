@@ -199,8 +199,14 @@ def load_sidecar_tokenizer(model_identifier: str):
             return AutoTokenizer.from_pretrained(
                 model_identifier, use_fast=True, local_files_only=True
             )
-        except Exception:
-            pass  # not in the global HF cache; try the sidecar path
+        except Exception as exc:
+            # Expected on cold pods; the sidecar download path follows.
+            logger.debug(
+                "%s not in the local HF cache (%s); trying the sidecar "
+                "download path",
+                model_identifier,
+                exc,
+            )
     try:
         path = fetch_tokenizer_files(model_identifier)
     except ImportError:  # no hub client available

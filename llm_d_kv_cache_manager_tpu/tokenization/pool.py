@@ -81,9 +81,9 @@ class TokenizationPool:
         self._queue: "queue.Queue[Optional[_Task]]" = queue.Queue(
             self.config.queue_size
         )
-        self._threads: List[threading.Thread] = []
+        self._threads: List[threading.Thread] = []  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._started = False
+        self._started = False  # guarded-by: _lock
 
     def set_tokenizer(self, tokenizer: Tokenizer, model_name: str) -> None:
         self._tokenizer = tokenizer

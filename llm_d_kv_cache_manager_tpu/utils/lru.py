@@ -44,7 +44,7 @@ class LRUCache(Generic[K, V]):
         if capacity <= 0:
             raise ValueError(f"LRU capacity must be positive, got {capacity}")
         self._capacity = capacity
-        self._data: "OrderedDict[K, V]" = OrderedDict()
+        self._data: "OrderedDict[K, V]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._on_evict = on_evict
 

@@ -25,7 +25,8 @@ class TTLCache(Generic[K, V]):
     ) -> None:
         self.ttl_seconds = ttl_seconds
         self._on_evict = on_evict
-        self._entries: Dict[K, tuple] = {}  # key -> (value, deadline)
+        # key -> (value, deadline)
+        self._entries: Dict[K, tuple] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         # Serializes set() against expiry callbacks so a re-insert can
         # never interleave between the is-it-still-absent check and the

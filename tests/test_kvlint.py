@@ -1,0 +1,540 @@
+"""kvlint (hack/kvlint) — the project-invariant static analyzer.
+
+Each rule gets at least one positive fixture (the violation is
+reported) and one negative fixture (the compliant twin passes); the
+CLI contract (``path:line: RULE: message``, exit 0/1) is pinned so
+``make kvlint`` output stays machine-parseable; and the tree itself
+must be clean — the same invocation CI runs.
+"""
+
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from hack.kvlint import check_file  # noqa: E402
+
+
+def lint(tmp_path, code, name="fixture.py", rules=None):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    return check_file(str(path), rules)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+class TestKV001LockDiscipline:
+    GOOD = """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}  # guarded-by: _lock
+
+            def get(self, key):
+                with self._lock:
+                    return self._data.get(key)
+
+            def _purge_locked(self):
+                self._data.clear()
+    """
+
+    def test_locked_access_passes(self, tmp_path):
+        assert lint(tmp_path, self.GOOD) == []
+
+    def test_unlocked_read_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            self.GOOD
+            + """
+            def peek(self, key):
+                return self._data.get(key)
+        """,
+        )
+        assert rule_ids(findings) == ["KV001"]
+        assert "_lock" in findings[0].message
+
+    def test_unlocked_write_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            self.GOOD
+            + """
+            def poke(self, key, value):
+                self._data[key] = value
+        """,
+        )
+        assert rule_ids(findings) == ["KV001"]
+
+    def test_caller_locked_suffix_and_mark(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            self.GOOD
+            + """
+            def _sweep_locked(self):
+                self._data.clear()
+
+            def reset(self):  # kvlint: caller-locked
+                self._data.clear()
+        """,
+        )
+        assert findings == []
+
+    def test_closure_does_not_inherit_lock(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            self.GOOD
+            + """
+            def escape(self):
+                with self._lock:
+                    def cb():
+                        return self._data
+                    return cb
+        """,
+        )
+        assert rule_ids(findings) == ["KV001"]
+
+    def test_inline_suppression(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            self.GOOD
+            + """
+            def peek(self):
+                return self._data  # kvlint: disable=KV001
+        """,
+        )
+        assert findings == []
+
+    def test_condition_guard(self, tmp_path):
+        """`with self._cond:` satisfies a guarded-by: _cond attr."""
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class Budget:
+                def __init__(self):
+                    self._in_flight = 0  # guarded-by: _cond
+                    self._cond = threading.Condition()
+
+                def release(self, n):
+                    with self._cond:
+                        self._in_flight -= n
+
+                def leak(self):
+                    return self._in_flight
+            """,
+        )
+        assert rule_ids(findings) == ["KV001"]
+        assert "_cond" in findings[0].message
+
+
+class TestKV002TracerSafety:
+    def test_branch_on_traced_param_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """,
+            name="ops/fixture.py",
+        )
+        assert rule_ids(findings) == ["KV002"]
+
+    def test_static_and_shape_branches_pass(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def f(x, flag=False):
+                if flag:
+                    return x * 2
+                if x.shape[0] > 4:
+                    return x
+                n = len(x)
+                if n > 2:
+                    return x
+                return x + 1
+            """,
+            name="ops/fixture.py",
+        )
+        assert findings == []
+
+    def test_pallas_kernel_via_partial(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import functools
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref, *, chunk):
+                if chunk > 4:
+                    o_ref[...] = x_ref[...]
+                t = x_ref[...]
+                if t[0] > 0:
+                    o_ref[...] = t
+
+            def run(x):
+                kernel = functools.partial(_kernel, chunk=8)
+                return pl.pallas_call(kernel, out_shape=x)(x)
+            """,
+            name="ops/fixture.py",
+        )
+        assert len(findings) == 1  # only the traced-ref branch
+        assert findings[0].rule == "KV002"
+
+    def test_host_random_and_time_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import random
+            import time
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x * random.random() + time.time()
+            """,
+            name="models/fixture.py",
+        )
+        assert rule_ids(findings) == ["KV002", "KV002"]
+
+    def test_out_of_scope_files_ignored(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """,
+            name="api/fixture.py",
+        )
+        assert findings == []
+
+    def test_plain_python_not_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def config_check(n):
+                if n > 0:
+                    return True
+                return bool(n)
+            """,
+            name="ops/fixture.py",
+        )
+        assert findings == []
+
+
+class TestKV003CanonicalSerialization:
+    def test_msgpack_in_persistence_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import msgpack
+
+            def save(doc):
+                return msgpack.packb(doc)
+            """,
+            name="persistence/fixture.py",
+        )
+        assert "KV003" in rule_ids(findings)
+        assert "cbor_canonical" in findings[0].message
+
+    def test_msgpack_on_the_wire_allowed(self, tmp_path):
+        """kvevents/ owns the msgpack wire format (vLLM contract)."""
+        findings = lint(
+            tmp_path,
+            """
+            import msgpack
+
+            def decode(payload):
+                return msgpack.unpackb(payload)
+            """,
+            name="kvevents/fixture.py",
+        )
+        assert findings == []
+
+    def test_pickle_banned_everywhere(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import pickle
+
+            def load(blob):
+                return pickle.loads(blob)
+            """,
+            name="api/fixture.py",
+        )
+        assert rule_ids(findings) == ["KV003", "KV003"]
+
+    def test_cbor_canonical_module_exempt(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import json
+
+            def debug_dump(doc):
+                return json.dumps(doc)
+            """,
+            name="kvcache/kvblock/cbor_canonical.py",
+        )
+        assert findings == []
+
+
+class TestKV004BlockingInAsync:
+    def test_sleep_in_async_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import time
+
+            async def handler():
+                time.sleep(1)
+            """,
+        )
+        assert rule_ids(findings) == ["KV004"]
+        assert "asyncio.sleep" in findings[0].message
+
+    def test_async_sleep_passes(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import asyncio
+
+            async def handler():
+                await asyncio.sleep(1)
+            """,
+        )
+        assert findings == []
+
+    def test_sync_socket_and_open_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            async def handler(sock):
+                data = sock.recv(1024)
+                with open("/tmp/x") as f:
+                    return f.read(), data
+            """,
+        )
+        assert sorted(rule_ids(findings)) == ["KV004", "KV004"]
+
+    def test_sync_function_not_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import time
+
+            def worker():
+                time.sleep(1)
+            """,
+        )
+        assert findings == []
+
+
+class TestKV005SwallowedErrors:
+    def test_bare_except_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def loop():
+                try:
+                    work()
+                except:
+                    pass
+            """,
+        )
+        assert rule_ids(findings) == ["KV005"]
+        assert "bare" in findings[0].message
+
+    def test_silent_broad_except_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def loop():
+                try:
+                    work()
+                except Exception:
+                    pass
+            """,
+        )
+        assert rule_ids(findings) == ["KV005"]
+
+    def test_logged_broad_except_passes(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def loop():
+                try:
+                    work()
+                except Exception:
+                    logger.exception("work failed; continuing")
+            """,
+        )
+        assert findings == []
+
+    def test_narrow_swallow_passes(self, tmp_path):
+        """`except queue.Full: pass` is control flow, not error hiding."""
+        findings = lint(
+            tmp_path,
+            """
+            import queue
+
+            def push(q, item):
+                try:
+                    q.put_nowait(item)
+                except queue.Full:
+                    pass
+            """,
+        )
+        assert findings == []
+
+    def test_del_exempt(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            class Engine:
+                def __del__(self):
+                    try:
+                        self.close()
+                    except Exception:
+                        pass
+            """,
+        )
+        assert findings == []
+
+
+def run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "hack.kvlint", *args],
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+    )
+
+
+class TestCLIContract:
+    """`path:line: RULE: message` on stdout, exit 0/1 — pinned so the
+    Makefile/CI/pre-commit wiring and editors can parse it forever."""
+
+    OUTPUT_RE = re.compile(r"^[^:]+:\d+: KV\d{3}: .+$")
+
+    def test_clean_tree_exits_zero(self):
+        proc = run_cli("llm_d_kv_cache_manager_tpu")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert proc.stdout == ""
+
+    def test_violation_output_format(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        pass\n"
+            "    except:\n"
+            "        pass\n"
+        )
+        proc = run_cli("--no-baseline", str(bad))
+        assert proc.returncode == 1
+        lines = proc.stdout.strip().splitlines()
+        assert lines, proc.stderr
+        for line in lines:
+            assert self.OUTPUT_RE.match(line), line
+
+    def test_seeded_guarded_by_violation_fails(self, tmp_path):
+        """Acceptance: an unlocked write to a guarded field in the real
+        tree makes the lint fail (the rule has teeth end to end)."""
+        src = os.path.join(
+            REPO, "llm_d_kv_cache_manager_tpu", "persistence", "journal.py"
+        )
+        with open(src) as handle:
+            code = handle.read()
+        seeded = code.replace(
+            "    def close(self) -> None:",
+            "    def poke(self) -> None:\n"
+            "        self._segment_bytes = 0\n"
+            "\n"
+            "    def close(self) -> None:",
+        )
+        assert seeded != code
+        bad = tmp_path / "journal_seeded.py"
+        bad.write_text(seeded)
+        proc = run_cli("--no-baseline", str(bad))
+        assert proc.returncode == 1
+        assert "KV001" in proc.stdout
+
+    def test_rule_filter(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import time\n"
+            "async def f():\n"
+            "    time.sleep(1)\n"
+            "    try:\n"
+            "        pass\n"
+            "    except:\n"
+            "        pass\n"
+        )
+        proc = run_cli("--no-baseline", "--rules", "KV004", str(bad))
+        assert proc.returncode == 1
+        assert "KV004" in proc.stdout and "KV005" not in proc.stdout
+
+
+class TestBaselineWorkflow:
+    def test_baselined_finding_suppressed_and_stale_reported(
+        self, tmp_path
+    ):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        pass\n"
+            "    except:\n"
+            "        pass\n"
+        )
+        baseline = tmp_path / "baseline.txt"
+        proc = run_cli(
+            "--baseline", str(baseline), "--write-baseline", str(bad)
+        )
+        assert proc.returncode == 0
+        assert baseline.exists()
+
+        proc = run_cli("--baseline", str(baseline), str(bad))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        # fix the violation -> the baseline entry is reported stale
+        bad.write_text("def f():\n    return 1\n")
+        proc = run_cli("--baseline", str(baseline), str(bad))
+        assert proc.returncode == 0
+        assert "stale baseline entry" in proc.stderr
+
+    def test_repo_baseline_is_empty(self):
+        """The shipped baseline carries no grandfathered findings —
+        new violations must be fixed or justified inline, not hidden."""
+        path = os.path.join(REPO, "hack", "kvlint", "baseline.txt")
+        with open(path) as handle:
+            entries = [
+                line
+                for line in handle
+                if line.strip() and not line.startswith("#")
+            ]
+        assert entries == []

@@ -71,6 +71,27 @@ class TestSubsetGate:
         proc = run_checker(str(good))
         assert proc.returncode == 0, proc.stdout
 
+    def test_rejects_early_break_with_wrappable_tail(self, tmp_path):
+        """The formerly-documented false negative: an over-limit line
+        whose only spaces sit before column 79 is still a violation
+        when the overflowing token would fit on its own continuation
+        line — clang-format would have wrapped at the early space and
+        produced no over-limit line at all."""
+        bad = tmp_path / "bad.cpp"
+        bad.write_text("  int value = " + "a" * 70 + ";\n")
+        proc = run_checker(str(bad))
+        assert proc.returncode == 1
+        assert "columns" in proc.stdout
+
+    def test_accepts_early_break_with_unwrappable_tail(self, tmp_path):
+        """...but when the final token cannot fit under the limit even
+        on its own continuation line, clang-format itself leaves it
+        overflowing — the gate must keep accepting that output."""
+        good = tmp_path / "good.cpp"
+        good.write_text("  return " + "a" * 85 + ";\n")
+        proc = run_checker(str(good))
+        assert proc.returncode == 0, proc.stdout
+
     def test_accepts_continuation_alignment(self, tmp_path):
         good = tmp_path / "good.cpp"
         good.write_text(
