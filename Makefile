@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint test unit-test e2e-test examples bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint test unit-test e2e-test examples obs-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -40,10 +40,16 @@ unit-test:
 	$(PYTHON) -m pytest tests/ -x -q
 
 e2e-test:
-	$(PYTHON) -m pytest tests/test_indexer_e2e.py tests/test_zmq_integration.py tests/test_grpc_api.py tests/test_http_service.py -q
+	$(PYTHON) -m pytest tests/test_indexer_e2e.py tests/test_zmq_integration.py tests/test_grpc_api.py tests/test_http_service.py tests/test_service_e2e.py tests/test_debug_surface.py -q
 
 examples:
 	bash hack/verify-examples.sh
+
+# Tracing debug-surface smoke (same invocation as CI's
+# "Observability smoke" step): booted service, traceparent round-trip,
+# /debug/traces retrieval, explain=1, /healthz block.
+obs-smoke:
+	$(PYTHON) hack/verify_observability.py
 
 # Fleet-routing benchmark; on TPU hardware drop JAX_PLATFORMS.
 bench:

@@ -6,6 +6,13 @@ and ``POST /score_chat_completions`` against the live indexer,
 ``GET /metrics`` (Prometheus exposition), ``GET /healthz``.  Stdlib
 ``http.server`` — threaded, no framework dependency.
 
+Observability surface (docs/observability.md): the scoring endpoints
+ingest and echo W3C ``traceparent`` (a sampled flag forces tracing),
+accept ``?explain=1`` for a per-stage latency breakdown plus per-pod
+score provenance, and the read-only flight-recorder endpoints
+``GET /debug/traces`` (``?kind=recent|slow|errored``) and
+``GET /debug/traces/<id>`` expose recent sampled traces.
+
 Run standalone (env-configured like the reference's example):
 
     PYTHONHASHSEED=42 BLOCK_SIZE=16 ZMQ_ENDPOINT=tcp://*:5557 \
@@ -20,10 +27,12 @@ import json
 import os
 import socket
 import threading
-from typing import Optional
+import urllib.parse
+from typing import Dict, Optional
 
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer
 from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.obs.trace import TRACER, use_trace
 from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
     ApplyChatTemplateRequest,
 )
@@ -53,7 +62,13 @@ def _make_handler(
         def log_message(self, *args):  # route through our logger
             logger.debug("http: " + args[0], *args[1:])
 
-        def _reply(self, status: int, body: bytes, content_type: str):
+        def _reply(
+            self,
+            status: int,
+            body: bytes,
+            content_type: str,
+            extra_headers: Optional[Dict[str, str]] = None,
+        ):
             # Centralized desync guard: replying while a declared
             # request body sits unconsumed (404 route, 403 admin gate,
             # any future early-reply path) leaves those bytes to be
@@ -68,14 +83,24 @@ def _make_handler(
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (extra_headers or {}).items():
+                self.send_header(name, value)
             if self.close_connection:
                 self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.write(body)
 
-        def _reply_json(self, status: int, obj) -> None:
+        def _reply_json(
+            self,
+            status: int,
+            obj,
+            extra_headers: Optional[Dict[str, str]] = None,
+        ) -> None:
             self._reply(
-                status, json.dumps(obj).encode(), "application/json"
+                status,
+                json.dumps(obj).encode(),
+                "application/json",
+                extra_headers,
             )
 
         def _error(self, status: int, message: str) -> None:
@@ -146,22 +171,83 @@ def _make_handler(
                 return None
             return obj
 
+        @staticmethod
+        def _split_path(raw_path: str):
+            """(path, {query}) with single-valued query params."""
+            parsed = urllib.parse.urlsplit(raw_path)
+            query = {
+                key: values[-1]
+                for key, values in urllib.parse.parse_qs(
+                    parsed.query
+                ).items()
+            }
+            return parsed.path, query
+
         def _do_get(self):
-            if self.path == "/metrics":
+            path, query = self._split_path(self.path)
+            if path == "/metrics":
                 self._reply(
                     200,
                     METRICS.exposition(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
-            elif self.path == "/healthz":
+            elif path == "/healthz":
                 health = {"status": "ok"}
+                # Sampling health without a scrape: ring occupancy and
+                # sampled/unsampled counters tell an operator whether
+                # the flight recorder is alive.
+                health["observability"] = TRACER.stats()
                 if recovery_report is not None:
                     health["recovery"] = recovery_report.to_dict()
                 if persistence is not None:
                     health["persistence"] = persistence.status()
                 self._reply_json(200, health)
+            elif path == "/debug/traces":
+                self._debug_traces(query)
+            elif path.startswith("/debug/traces/"):
+                self._debug_trace_by_id(path[len("/debug/traces/"):])
             else:
                 self._error(404, "not found")
+
+        def _debug_traces(self, query):
+            """Read-only flight-recorder listing (span-free summaries;
+            fetch /debug/traces/<id> for full spans)."""
+            kind = query.get("kind", "recent")
+            try:
+                limit = max(1, min(int(query.get("limit", "50")), 1000))
+            except ValueError:
+                self._error(400, "invalid 'limit'")
+                return
+            recorder = TRACER.recorder
+            if kind == "recent":
+                traces = recorder.recent(limit)
+            elif kind == "slow":
+                traces = recorder.slow(limit)
+            elif kind == "errored":
+                traces = recorder.errored(limit)
+            else:
+                self._error(
+                    400, "kind must be one of recent|slow|errored"
+                )
+                return
+            self._reply_json(
+                200,
+                {
+                    "kind": kind,
+                    "count": len(traces),
+                    "stats": TRACER.stats(),
+                    "traces": [
+                        t.to_dict(include_spans=False) for t in traces
+                    ],
+                },
+            )
+
+        def _debug_trace_by_id(self, trace_id: str):
+            found = TRACER.recorder.get(trace_id)
+            if found is None:
+                self._error(404, "trace not found (evicted or never sampled)")
+                return
+            self._reply_json(200, found.to_dict())
 
         def _declares_body(self) -> bool:
             if self.headers.get("Transfer-Encoding"):
@@ -180,13 +266,14 @@ def _make_handler(
             # without it drops the connection.
             self._body_consumed = False
             try:
-                if self.path == "/score_completions":
-                    self._score_completions()
-                elif self.path == "/score_chat_completions":
-                    self._score_chat_completions()
-                elif self.path == "/admin/purge_pod":
+                path, query = self._split_path(self.path)
+                if path == "/score_completions":
+                    self._score_completions(query)
+                elif path == "/score_chat_completions":
+                    self._score_chat_completions(query)
+                elif path == "/admin/purge_pod":
                     self._purge_pod()
-                elif self.path == "/admin/snapshot":
+                elif path == "/admin/snapshot":
                     self._snapshot()
                 else:
                     self._error(404, "not found")
@@ -265,7 +352,60 @@ def _make_handler(
                 },
             )
 
-        def _score_completions(self):
+        @staticmethod
+        def _wants_explain(query) -> bool:
+            return query.get("explain", "").lower() in ("1", "true", "yes")
+
+        def _run_scored(self, name, query, score_kwargs):
+            """Shared scoring execution: trace lifecycle (traceparent
+            ingest/echo, ``?explain=1`` forcing a sample), the explain
+            response shape, and error accounting.  ``score_kwargs`` are
+            handed to ``Indexer.get_pod_scores[_explained]``."""
+            explain = self._wants_explain(query)
+            req_trace = TRACER.start_trace(
+                name,
+                traceparent=self.headers.get("traceparent"),
+                force=explain,
+            )
+            try:
+                with use_trace(req_trace):
+                    if explain:
+                        scores, detail = (
+                            indexer.get_pod_scores_explained(**score_kwargs)
+                        )
+                    else:
+                        scores, detail = (
+                            indexer.get_pod_scores(**score_kwargs),
+                            None,
+                        )
+            except Exception as exc:
+                if req_trace is not None:
+                    req_trace.set_error(repr(exc))
+                    req_trace.finish("error")
+                logger.exception("%s failed", name)
+                self._error(500, f"error: {exc}")
+                return
+            headers: Dict[str, str] = {}
+            if req_trace is not None:
+                # Finish BEFORE replying so the trace is retrievable
+                # from /debug/traces the moment the client sees the
+                # echoed traceparent.
+                req_trace.finish()
+                headers["traceparent"] = req_trace.traceparent()
+            if not explain:
+                self._reply_json(200, scores, headers)
+                return
+            # explain forces sampling, so req_trace is always live here.
+            trace_view = req_trace.to_dict(include_spans=False)
+            detail = dict(detail)
+            detail["trace_id"] = req_trace.trace_id
+            detail["duration_ms"] = trace_view["duration_ms"]
+            detail["stages"] = trace_view["stages"]
+            self._reply_json(
+                200, {"scores": scores, "explain": detail}, headers
+            )
+
+        def _score_completions(self, query):
             request = self._read_json()
             if request is None:
                 return
@@ -273,19 +413,17 @@ def _make_handler(
             if not prompt:
                 self._error(400, "field 'prompt' required")
                 return
-            try:
-                scores = indexer.get_pod_scores(
+            self._run_scored(
+                "http.score_completions",
+                query,
+                dict(
                     prompt=prompt,
                     model_name=request.get("model", ""),
                     pod_identifiers=request.get("pods"),
-                )
-            except Exception as exc:
-                logger.exception("score_completions failed")
-                self._error(500, f"error: {exc}")
-                return
-            self._reply_json(200, scores)
+                ),
+            )
 
-        def _score_chat_completions(self):
+        def _score_chat_completions(self, query):
             request = self._read_json()
             if request is None:
                 return
@@ -308,18 +446,16 @@ def _make_handler(
                 chat_template_kwargs=request.get("chat_template_kwargs"),
                 model=model,
             )
-            try:
-                scores = indexer.get_pod_scores(
+            self._run_scored(
+                "http.score_chat_completions",
+                query,
+                dict(
                     prompt="",
                     model_name=model,
                     pod_identifiers=request.get("pods"),
                     render_req=render_req,
-                )
-            except Exception as exc:
-                logger.exception("score_chat_completions failed")
-                self._error(500, f"error: {exc}")
-                return
-            self._reply_json(200, scores)
+                ),
+            )
 
     return Handler
 

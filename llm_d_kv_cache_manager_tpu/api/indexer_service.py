@@ -20,6 +20,7 @@ from llm_d_kv_cache_manager_tpu.api.grpc_services import (
     add_indexer_servicer,
 )
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer
+from llm_d_kv_cache_manager_tpu.obs.trace import TRACER, use_trace
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("api.indexer_service")
@@ -30,16 +31,40 @@ class IndexerGrpcService(IndexerServiceServicer):
         self.indexer = indexer
 
     def GetPodScores(self, request, context):
+        # W3C traceparent rides gRPC metadata (same semantics as the
+        # HTTP header): a sampled flag forces tracing, and the server's
+        # own traceparent is echoed in the initial metadata.
+        traceparent = None
+        for key, value in context.invocation_metadata() or ():
+            if key == "traceparent":
+                traceparent = value
+        req_trace = TRACER.start_trace(
+            "grpc.get_pod_scores", traceparent=traceparent
+        )
         try:
-            scores = self.indexer.get_pod_scores(
-                prompt=request.prompt,
-                model_name=request.model_name,
-                pod_identifiers=list(request.pod_identifiers) or None,
-            )
+            with use_trace(req_trace):
+                scores = self.indexer.get_pod_scores(
+                    prompt=request.prompt,
+                    model_name=request.model_name,
+                    pod_identifiers=list(request.pod_identifiers) or None,
+                )
         except Exception as exc:
+            if req_trace is not None:
+                req_trace.set_error(repr(exc))
+                req_trace.finish("error")
             logger.exception("GetPodScores failed")
             context.abort(grpc.StatusCode.INTERNAL, str(exc))
             return indexer_pb2.GetPodScoresResponse()
+        if req_trace is not None:
+            req_trace.finish()
+            try:
+                context.send_initial_metadata(
+                    (("traceparent", req_trace.traceparent()),)
+                )
+            except Exception as exc:  # noqa: BLE001 - echo is best-effort
+                # Headers may already be on the wire; the trace itself
+                # is recorded either way.
+                logger.debug("traceparent metadata echo failed: %s", exc)
         response = indexer_pb2.GetPodScoresResponse()
         # Deterministic order: score desc, pod asc (stable for clients).
         for pod, score in sorted(

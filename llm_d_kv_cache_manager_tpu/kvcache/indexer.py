@@ -17,7 +17,7 @@ applications construct the whole stack from a single literal.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
     Index,
@@ -35,6 +35,7 @@ from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
     ScorerConfig,
     new_scorer,
 )
+from llm_d_kv_cache_manager_tpu.obs.trace import span as obs_span
 from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
     ApplyChatTemplateRequest,
     ChatTemplatingProcessor,
@@ -131,6 +132,30 @@ class Indexer:
     def set_tokenizer(self, tokenizer: Tokenizer, model_name: str) -> None:
         self.tokenization_pool.set_tokenizer(tokenizer, model_name)
 
+    def _tokens_and_block_keys(
+        self,
+        prompt: str,
+        model_name: str,
+        render_req: Optional[ApplyChatTemplateRequest],
+    ) -> Tuple[List[int], List[int]]:
+        """Shared front half of the read path: prompt -> tokens -> chained
+        block keys, with per-stage spans when a trace is active (the
+        tokenization pool adds its own sub-spans under "tokenize")."""
+        with obs_span("tokenize") as s:
+            tokens = self.tokenization_pool.tokenize(
+                prompt, model_name, render_req
+            )
+            s.set_attr("tokens", len(tokens))
+        trace(logger, "tokenized prompt to %d tokens", len(tokens))
+
+        with obs_span("hash_blocks") as s:
+            block_keys = self.token_processor.tokens_to_kv_block_keys(
+                EMPTY_BLOCK_HASH, tokens, model_name
+            )
+            s.set_attr("block_keys", len(block_keys))
+        trace(logger, "derived %d block keys", len(block_keys))
+        return tokens, block_keys
+
     def get_pod_scores(
         self,
         prompt: str,
@@ -143,22 +168,59 @@ class Indexer:
         ``pod_identifiers`` filters the result; None/empty scores every pod
         the index knows about.
         """
-        tokens = self.tokenization_pool.tokenize(
+        _, block_keys = self._tokens_and_block_keys(
             prompt, model_name, render_req
-        )
-        trace(logger, "tokenized prompt to %d tokens", len(tokens))
-
-        block_keys = self.token_processor.tokens_to_kv_block_keys(
-            EMPTY_BLOCK_HASH, tokens, model_name
         )
         if not block_keys:
             return {}
-        trace(logger, "derived %d block keys", len(block_keys))
 
         pod_set = set(pod_identifiers) if pod_identifiers else None
-        key_to_pods = self.kv_block_index.lookup(block_keys, pod_set)
-        scores = self.scorer.score(block_keys, key_to_pods)
+        with obs_span("index_lookup") as s:
+            key_to_pods = self.kv_block_index.lookup(block_keys, pod_set)
+            s.set_attr("keys_hit", len(key_to_pods))
+        with obs_span("score") as s:
+            scores = self.scorer.score(block_keys, key_to_pods)
+            s.set_attr("pods", len(scores))
         logger.debug(
             "scored %d pods over %d block keys", len(scores), len(block_keys)
         )
         return scores
+
+    def get_pod_scores_explained(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Optional[Sequence[str]] = None,
+        render_req: Optional[ApplyChatTemplateRequest] = None,
+    ) -> Tuple[Dict[str, float], Dict]:
+        """``get_pod_scores`` plus a per-pod score explanation.
+
+        Returns ``(scores, explanation)``; scores are identical to
+        ``get_pod_scores``.  The explanation carries token/block-key
+        counts and, per pod, blocks matched, the block index where the
+        consecutive-prefix chain broke, and per-tier hit counts (see
+        ``LongestPrefixScorer.explain``).  The debug surface — slower
+        than the hot path by the explain bookkeeping; not for every
+        request.
+        """
+        tokens, block_keys = self._tokens_and_block_keys(
+            prompt, model_name, render_req
+        )
+        explanation: Dict = {
+            "tokens": len(tokens),
+            "block_keys": len(block_keys),
+            "pods": {},
+        }
+        if not block_keys:
+            return {}, explanation
+
+        pod_set = set(pod_identifiers) if pod_identifiers else None
+        with obs_span("index_lookup") as s:
+            key_to_pods = self.kv_block_index.lookup(block_keys, pod_set)
+            s.set_attr("keys_hit", len(key_to_pods))
+        with obs_span("score") as s:
+            per_pod = self.scorer.explain(block_keys, key_to_pods)
+            s.set_attr("pods", len(per_pod))
+        explanation["pods"] = per_pod
+        scores = {pod: detail["score"] for pod, detail in per_pod.items()}
+        return scores, explanation

@@ -51,15 +51,23 @@ class LongestPrefixScorer:
     def __init__(self, tier_weights: Mapping[str, float]) -> None:
         self.tier_weights = dict(tier_weights)
 
-    def _max_weight(self, entries: Sequence[PodEntry], pod_id: str) -> float:
-        best = 0.0
+    def _best_entry(
+        self, entries: Sequence[PodEntry], pod_id: str
+    ) -> tuple:
+        """(max weight, its tier) for one pod's entries on one block.
+        Single source of tier-weight resolution: ``score`` and
+        ``explain`` both resolve through here, so they cannot drift."""
+        best, tier = 0.0, None
         for entry in entries:
             if entry.pod_identifier != pod_id:
                 continue
             weight = self.tier_weights.get(entry.device_tier, 1.0)
-            if weight > best:
-                best = weight
-        return best
+            if tier is None or weight > best:
+                best, tier = weight, entry.device_tier
+        return best, tier
+
+    def _max_weight(self, entries: Sequence[PodEntry], pod_id: str) -> float:
+        return self._best_entry(entries, pod_id)[0]
 
     def score(
         self,
@@ -83,6 +91,52 @@ class LongestPrefixScorer:
             for pod in active:
                 scores[pod] += self._max_weight(pods, pod)
         return scores
+
+    def explain(
+        self,
+        keys: Sequence[int],
+        key_to_pods: Mapping[int, Sequence[PodEntry]],
+    ) -> Dict[str, dict]:
+        """Score with per-pod provenance (the ``explain=1`` surface).
+
+        For each pod appearing on block 0: its score (identical to
+        ``score()``), how many consecutive blocks matched, the block
+        index where its prefix chain broke (``None`` when it survived
+        every looked-up block), and per-tier counts of the blocks that
+        scored (which memory tier each hit came from).  Pods missing
+        from block 0 score 0 in ``score()`` and are omitted here, same
+        as there.
+        """
+        if not keys:
+            return {}
+
+        first_pods = key_to_pods.get(keys[0], ())
+        active = {p.pod_identifier for p in first_pods}
+        result: Dict[str, dict] = {}
+        for pod in active:
+            weight, tier = self._best_entry(first_pods, pod)
+            result[pod] = {
+                "score": weight,
+                "blocks_matched": 1,
+                "break_index": None,
+                "tiers": {tier: 1},
+            }
+
+        for i, key in enumerate(keys[1:], start=1):
+            if not active:
+                break
+            pods = key_to_pods.get(key, ())
+            present = {p.pod_identifier for p in pods}
+            for pod in active - present:
+                result[pod]["break_index"] = i
+            active &= present
+            for pod in active:
+                weight, tier = self._best_entry(pods, pod)
+                entry = result[pod]
+                entry["score"] += weight
+                entry["blocks_matched"] += 1
+                entry["tiers"][tier] = entry["tiers"].get(tier, 0) + 1
+        return result
 
 
 def new_scorer(config: ScorerConfig) -> LongestPrefixScorer:

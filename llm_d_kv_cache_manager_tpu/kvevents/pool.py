@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -57,6 +58,13 @@ from llm_d_kv_cache_manager_tpu.kvevents.events import (
     decode_event_batch,
 )
 from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.obs.trace import (
+    TRACER,
+    Trace,
+    current_trace,
+    span as obs_span,
+    use_trace,
+)
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
 
 logger = get_logger("kvevents.pool")
@@ -85,6 +93,14 @@ class Message:
     pod_identifier: str
     model_name: str
     seq: int = 0
+    # Events lost to a publisher sequence gap *immediately before* this
+    # message (set by the subscriber); traced messages surface it so a
+    # slow/strange apply can be correlated with upstream loss.
+    seq_gap: int = 0
+    # Sampled ingestion trace (obs/trace.py) riding the shard queue:
+    # explicit propagation across the pool's thread boundary.
+    trace: Optional[Trace] = None
+    enqueued_at: float = 0.0
 
 
 @dataclass
@@ -158,7 +174,24 @@ class Pool:
         for q in self._queues:
             q.join()
 
+    @staticmethod
+    def _finish_dropped(dropped: Message, reason: str) -> None:
+        """A shed message's trace must still reach the recorder: drops
+        ARE the incident the flight recorder exists to explain."""
+        if dropped.trace is not None:
+            dropped.trace.set_error(f"dropped: {reason}")
+            dropped.trace.finish("error")
+
     def add_task(self, message: Message) -> None:
+        if message.trace is None:
+            tr = TRACER.start_trace("kvevents.message")
+            if tr is not None:
+                tr.set_attr("pod", message.pod_identifier)
+                tr.set_attr("topic", message.topic)
+                tr.set_attr("seq", message.seq)
+                message.trace = tr
+        if message.trace is not None:
+            message.enqueued_at = time.perf_counter()
         shard = fnv1a_32(message.pod_identifier.encode()) % len(self._queues)
         q = self._queues[shard]
         while True:
@@ -189,8 +222,10 @@ class Pool:
                         shard,
                     )
                 METRICS.kvevents_dropped.labels(reason="shutdown").inc()
+                self._finish_dropped(message, "shutdown")
                 return
             METRICS.kvevents_dropped.labels(reason="queue_full").inc()
+            self._finish_dropped(dropped, "queue_full")
             logger.debug(
                 "event shard %d full (depth %d); dropped oldest message "
                 "from pod %s",
@@ -199,8 +234,8 @@ class Pool:
                 dropped.pod_identifier,
             )
 
-    @staticmethod
-    def _put_sentinel(q: "queue.Queue[Optional[Message]]") -> None:
+    @classmethod
+    def _put_sentinel(cls, q: "queue.Queue[Optional[Message]]") -> None:
         """Enqueue the stop sentinel, shedding old messages if full."""
         while True:
             try:
@@ -208,9 +243,11 @@ class Pool:
                 return
             except queue.Full:
                 try:
-                    q.get_nowait()
+                    shed = q.get_nowait()
                     q.task_done()
                     METRICS.kvevents_dropped.labels(reason="shutdown").inc()
+                    if shed is not None:
+                        cls._finish_dropped(shed, "shutdown")
                 except queue.Empty:
                     pass
 
@@ -231,28 +268,56 @@ class Pool:
                 q.task_done()
 
     def _process_message(self, message: Message) -> None:
-        try:
-            batch = decode_event_batch(message.payload)
-        except EventDecodeError as exc:
-            # Data loss, not noise: this pod's cache state is now stale
-            # until its next re-store event.
-            logger.warning(
-                "dropping poison-pill message from pod %s (topic %s): %s",
-                message.pod_identifier,
-                message.topic,
-                exc,
-            )
+        tr = message.trace
+        if tr is None:
+            self._decode_and_apply(message)
             return
+        # Queue wait vs apply time is the shard-health split: a storm
+        # shows up as queue_wait, a stuck index backend as apply.
+        tr.add_completed("kvevents.queue_wait", message.enqueued_at)
+        if message.seq_gap:
+            tr.set_attr("seq_gap", message.seq_gap)
+        try:
+            with use_trace(tr):
+                self._decode_and_apply(message)
+        except Exception as exc:
+            tr.set_error(repr(exc))
+            tr.finish("error")
+            raise
+        tr.finish()
 
-        for raw_event in batch.events:
+    def _decode_and_apply(self, message: Message) -> None:
+        with obs_span("kvevents.decode") as s:
             try:
-                event = decode_event(raw_event)
-            except (EventDecodeError, TypeError, ValueError) as exc:
-                # Per-event skip: one malformed event must not drop the
-                # rest of the batch.
-                logger.debug("skipping undecodable event: %s", exc)
-                continue
-            self._digest(message, event)
+                batch = decode_event_batch(message.payload)
+            except EventDecodeError as exc:
+                # Data loss, not noise: this pod's cache state is now
+                # stale until its next re-store event.
+                logger.warning(
+                    "dropping poison-pill message from pod %s (topic %s): %s",
+                    message.pod_identifier,
+                    message.topic,
+                    exc,
+                )
+                active = current_trace()
+                if active is not None:
+                    active.set_error(f"poison pill: {exc}")
+                return
+            s.set_attr("events", len(batch.events))
+
+        with obs_span("kvevents.apply") as s:
+            applied = 0
+            for raw_event in batch.events:
+                try:
+                    event = decode_event(raw_event)
+                except (EventDecodeError, TypeError, ValueError) as exc:
+                    # Per-event skip: one malformed event must not drop
+                    # the rest of the batch.
+                    logger.debug("skipping undecodable event: %s", exc)
+                    continue
+                self._digest(message, event)
+                applied += 1
+            s.set_attr("applied", applied)
 
     def _digest(self, message: Message, event) -> None:
         if isinstance(event, BlockStored):

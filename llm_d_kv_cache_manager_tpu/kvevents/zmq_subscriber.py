@@ -179,27 +179,31 @@ class ZMQSubscriber:
         pod_id, model = parsed
 
         seq = 0
+        gap = 0
         if len(seq_raw) == 8:
             seq = struct.unpack(">Q", seq_raw)[0]
             last_seq = self._last_seq_by_topic.get(topic)
             if last_seq is not None and seq > last_seq + 1:
-                lost = seq - last_seq - 1
-                self.gap_count += lost
-                METRICS.kvevents_seq_gaps.labels(pod=pod_id).inc(lost)
+                gap = seq - last_seq - 1
+                self.gap_count += gap
+                METRICS.kvevents_seq_gaps.labels(pod=pod_id).inc(gap)
                 logger.warning(
                     "sequence gap on %s: %d -> %d (%d events lost)",
                     topic,
                     last_seq,
                     seq,
-                    lost,
+                    gap,
                 )
             self._last_seq_by_topic[topic] = seq
 
         trace(logger, "message topic=%s seq=%d", topic, seq)
+        # seq_gap rides the message so a sampled ingestion trace can
+        # surface the publisher-side loss alongside queue/apply timing.
         return Message(
             topic=topic,
             payload=payload,
             pod_identifier=pod_id,
             model_name=model,
             seq=seq,
+            seq_gap=gap,
         )

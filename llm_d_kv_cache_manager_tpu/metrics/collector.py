@@ -69,6 +69,14 @@ class KVCacheMetrics:
             "Latency of tokenization calls by backend.",
             ("tokenizer",),
             registry=self.registry,
+            # Sub-millisecond resolution: the prefix-store fast path and
+            # local fast tokenizers finish far below the Prometheus
+            # default 5ms first bucket (same style as
+            # index_lookup_latency above).
+            buckets=(
+                0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+            ),
         )
         self.tokenization_tokens = Counter(
             f"{_NAMESPACE}_tokenization_tokens_total",
@@ -140,6 +148,23 @@ class KVCacheMetrics:
             ("direction", "status"),
             registry=self.registry,
         )
+        # Per-stage latencies fed by the tracing subsystem (obs/trace.py):
+        # every span of a sampled trace lands here under its span name, so
+        # the aggregate view and the per-request flight-recorder view
+        # share one stage vocabulary ("tokenize", "index_lookup",
+        # "kvevents.apply", "offload.io", ...).  Only sampled requests
+        # contribute — at low TRACE_SAMPLE_RATE this is an unbiased
+        # sample of the stage mix, not a total count.
+        self.stage_latency = Histogram(
+            f"{_NAMESPACE}_stage_latency_seconds",
+            "Per-stage latency of traced requests, by pipeline stage.",
+            ("stage",),
+            registry=self.registry,
+            buckets=(
+                0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+            ),
+        )
 
     def exposition(self) -> bytes:
         return generate_latest(self.registry)
@@ -150,22 +175,50 @@ class KVCacheMetrics:
 METRICS = KVCacheMetrics()
 
 
+def counter_total(counter: Counter) -> float:
+    """Sum of a counter's ``_total`` samples across all label sets.
+
+    ``collect()[0].samples[0]`` only works for unlabeled counters — a
+    labeled counter's first sample is whichever label set was created
+    first (and with no children yet there are NO samples at all).
+    Summing by the ``_total`` suffix handles unlabeled, labeled, and
+    empty counters alike and skips ``_created`` gauge samples.
+    """
+    total = 0.0
+    for metric in counter.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total"):
+                total += sample.value
+    return total
+
+
+def gauge_value(gauge: Gauge) -> float:
+    """Current value of an unlabeled gauge (0.0 when never set)."""
+    for metric in gauge.collect():
+        for sample in metric.samples:
+            return sample.value
+    return 0.0
+
+
 def start_metrics_logging(interval_seconds: float = 60.0) -> threading.Event:
     """Log a periodic one-line metrics beat; returns a stop event."""
     stop = threading.Event()
 
-    def counter_value(counter: Counter) -> float:
-        # Public API: first sample of a Counter is its _total value.
-        return counter.collect()[0].samples[0].value
-
     def beat() -> None:
         while not stop.wait(interval_seconds):
+            # dropped_events and journal_lag earn their place on the
+            # line during incidents: a climbing drop count means event
+            # shards are shedding (stale index), a climbing lag means a
+            # crash right now replays that many journal records.
             logger.info(
-                "metrics beat: admissions=%d evictions=%d lookups=%d hits=%d",
-                counter_value(METRICS.index_admissions),
-                counter_value(METRICS.index_evictions),
-                counter_value(METRICS.index_lookup_requests),
-                counter_value(METRICS.index_lookup_hits),
+                "metrics beat: admissions=%d evictions=%d lookups=%d "
+                "hits=%d dropped_events=%d journal_lag=%d",
+                counter_total(METRICS.index_admissions),
+                counter_total(METRICS.index_evictions),
+                counter_total(METRICS.index_lookup_requests),
+                counter_total(METRICS.index_lookup_hits),
+                counter_total(METRICS.kvevents_dropped),
+                gauge_value(METRICS.persistence_journal_lag),
             )
 
     thread = threading.Thread(target=beat, name="kvtpu-metrics-beat", daemon=True)

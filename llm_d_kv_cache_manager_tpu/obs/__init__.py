@@ -1,0 +1,38 @@
+"""Observability: request-scoped tracing + flight recorder.
+
+See docs/observability.md.  Import surface:
+
+    from llm_d_kv_cache_manager_tpu.obs import (
+        TRACER, current_trace, span, use_trace,
+    )
+"""
+
+from llm_d_kv_cache_manager_tpu.obs.recorder import FlightRecorder
+from llm_d_kv_cache_manager_tpu.obs.trace import (
+    TRACER,
+    ParentContext,
+    Span,
+    Trace,
+    Tracer,
+    TracerConfig,
+    current_trace,
+    format_traceparent,
+    parse_traceparent,
+    span,
+    use_trace,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "TRACER",
+    "ParentContext",
+    "Span",
+    "Trace",
+    "Tracer",
+    "TracerConfig",
+    "current_trace",
+    "format_traceparent",
+    "parse_traceparent",
+    "span",
+    "use_trace",
+]

@@ -29,12 +29,18 @@ gather for all layers), so the host path transposes at the boundary.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import ml_dtypes  # ships with jax; registers bfloat16 as a numpy dtype
 import numpy as np
 
 from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.obs.trace import (
+    TRACER,
+    span as obs_span,
+    use_trace,
+)
 from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import KVCachePool
 from llm_d_kv_cache_manager_tpu.native.engine import (
     JobStatus,
@@ -122,6 +128,37 @@ class _HandlerBase:
         # completion, success or not.
         self._budget = staging_budget
         self._budget_bytes: Dict[int, int] = {}
+        # Sampled per-job traces: job_id -> (trace, io-start stamp).
+        # Submit-to-harvest, same single-submitter discipline as the
+        # other per-job dicts here.
+        self._job_traces: Dict[int, Tuple[object, float]] = {}
+
+    def _trace_submit(self, name: str, job_id: int, n_blocks: int):
+        """Sampled trace for one offload job; None when unsampled."""
+        job_trace = TRACER.start_trace(name)
+        if job_trace is not None:
+            job_trace.set_attr("job_id", job_id)
+            job_trace.set_attr("blocks", n_blocks)
+        return job_trace
+
+    def _trace_io_start(self, job_id: int, job_trace) -> None:
+        if job_trace is not None:
+            self._job_traces[job_id] = (job_trace, time.perf_counter())
+
+    def _trace_finish(self, job_id: int, status: JobStatus) -> None:
+        """Close the job's io span at harvest.  The io span covers
+        engine submit -> completion harvest: actual file/DMA time plus
+        any idle-until-harvest slack, which is exactly the latency the
+        serving step experiences."""
+        entry = self._job_traces.pop(job_id, None)
+        if entry is None:
+            return
+        job_trace, io_start = entry
+        job_trace.add_completed("offload.io", io_start)
+        job_trace.set_attr("status", status.name.lower())
+        job_trace.finish(
+            "ok" if status == JobStatus.SUCCEEDED else "error"
+        )
 
     def _budget_acquire(self, job_id: int, nbytes: int) -> None:
         if self._budget is not None and nbytes > 0:
@@ -172,36 +209,42 @@ class DeviceToStorageHandler(_HandlerBase):
         all_ids: List[int] = []
         for _, ids in groups:
             all_ids.extend(ids)
+        job_trace = self._trace_submit("offload.store", job_id, len(all_ids))
         # Gate on the staging budget before the gather allocates.
         self._budget_acquire(
             job_id, len(all_ids) * self.pool.block_nbytes
         )
-        # One gather + one DMA for the whole job.
-        host = self.pool.gather_to_host(all_ids)  # [L, n, 2, bs, h, d]
+        with use_trace(job_trace), obs_span("offload.stage") as stage:
+            # One gather + one DMA for the whole job.
+            host = self.pool.gather_to_host(all_ids)  # [L, n, 2, bs, h, d]
 
-        paths: List[str] = []
-        buffers: List[np.ndarray] = []
-        cursor = 0
-        for file_hash, ids in groups:
-            paths.append(self.file_mapper.get_file_name(file_hash))
-            chunk = host[:, cursor : cursor + len(ids)]
-            # Layer-major gather -> block-major file bytes (see module
-            # docstring: head-of-file == first blocks).
-            buffers.append(np.ascontiguousarray(np.moveaxis(chunk, 1, 0)))
-            cursor += len(ids)
-        if self._host_cache is not None:
-            admitted = [
-                file_hash
-                for (file_hash, _), buffer in zip(groups, buffers)
-                if self._host_cache.put(file_hash, buffer)
-            ]
-            # Advertise only what the budget actually admitted.
-            if admitted and self._event_sink is not None:
-                self._event_sink(admitted, HOST_MEDIUM)
+            paths: List[str] = []
+            buffers: List[np.ndarray] = []
+            cursor = 0
+            for file_hash, ids in groups:
+                paths.append(self.file_mapper.get_file_name(file_hash))
+                chunk = host[:, cursor : cursor + len(ids)]
+                # Layer-major gather -> block-major file bytes (see
+                # module docstring: head-of-file == first blocks).
+                buffers.append(
+                    np.ascontiguousarray(np.moveaxis(chunk, 1, 0))
+                )
+                cursor += len(ids)
+            if self._host_cache is not None:
+                admitted = [
+                    file_hash
+                    for (file_hash, _), buffer in zip(groups, buffers)
+                    if self._host_cache.put(file_hash, buffer)
+                ]
+                # Advertise only what the budget actually admitted.
+                if admitted and self._event_sink is not None:
+                    self._event_sink(admitted, HOST_MEDIUM)
+            stage.set_attr("files", len(paths))
         self._job_hashes[job_id] = (
             [h for h, _ in groups],
             sum(buffer.nbytes for buffer in buffers),
         )
+        self._trace_io_start(job_id, job_trace)
         self.engine.store(job_id, paths, buffers, skip_existing=True)
 
     def owns(self, job_id: int) -> bool:
@@ -209,6 +252,7 @@ class DeviceToStorageHandler(_HandlerBase):
 
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
         self._budget_release(job_id)
+        self._trace_finish(job_id, status)
         hashes, nbytes = self._job_hashes.pop(job_id, (None, 0))
         if hashes is None:
             # A completion this handler never submitted (or one already
@@ -248,38 +292,43 @@ class StorageToDeviceHandler(_HandlerBase):
     ) -> None:
         c = self.pool.config
         n_blocks = sum(len(ids) for _, ids in groups)
+        job_trace = self._trace_submit("offload.load", job_id, n_blocks)
         self._budget_acquire(job_id, n_blocks * self.pool.block_nbytes)
-        paths: List[str] = []
-        buffers: List[np.ndarray] = []
-        file_buffers: List[np.ndarray] = []
-        all_ids: List[int] = []
-        for file_hash, ids in groups:
-            cached = (
-                self._host_cache.get(file_hash)
-                if self._host_cache is not None
-                else None
-            )
-            if cached is not None and cached.shape[0] >= len(ids):
-                # Host-tier hit: a partial request reads the group's
-                # head blocks (block-major layout invariant).
-                buffers.append(cached[: len(ids)])
-            else:
-                buffer = np.empty(
-                    (
-                        len(ids),
-                        c.num_layers,
-                        2,
-                        c.block_size,
-                        c.num_kv_heads,
-                        c.head_dim,
-                    ),
-                    dtype=host_dtype(c.dtype),
+        with use_trace(job_trace), obs_span("offload.stage") as stage:
+            paths: List[str] = []
+            buffers: List[np.ndarray] = []
+            file_buffers: List[np.ndarray] = []
+            all_ids: List[int] = []
+            for file_hash, ids in groups:
+                cached = (
+                    self._host_cache.get(file_hash)
+                    if self._host_cache is not None
+                    else None
                 )
-                buffers.append(buffer)
-                file_buffers.append(buffer)
-                paths.append(self.file_mapper.get_file_name(file_hash))
-            all_ids.extend(ids)
+                if cached is not None and cached.shape[0] >= len(ids):
+                    # Host-tier hit: a partial request reads the group's
+                    # head blocks (block-major layout invariant).
+                    buffers.append(cached[: len(ids)])
+                else:
+                    buffer = np.empty(
+                        (
+                            len(ids),
+                            c.num_layers,
+                            2,
+                            c.block_size,
+                            c.num_kv_heads,
+                            c.head_dim,
+                        ),
+                        dtype=host_dtype(c.dtype),
+                    )
+                    buffers.append(buffer)
+                    file_buffers.append(buffer)
+                    paths.append(self.file_mapper.get_file_name(file_hash))
+                all_ids.extend(ids)
+            stage.set_attr("files", len(paths))
+            stage.set_attr("host_tier_hits", len(buffers) - len(file_buffers))
         self._pending[job_id] = (all_ids, buffers)
+        self._trace_io_start(job_id, job_trace)
         # Zero-file jobs still register so get_finished reports them.
         self.engine.load(job_id, paths, file_buffers)
 
@@ -288,6 +337,7 @@ class StorageToDeviceHandler(_HandlerBase):
 
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
         self._budget_release(job_id)
+        self._trace_finish(job_id, status)
         pending = self._pending.pop(job_id, None)
         METRICS.offload_jobs.labels("load", status.name.lower()).inc()
         if pending is None:

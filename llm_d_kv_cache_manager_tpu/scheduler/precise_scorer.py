@@ -27,6 +27,7 @@ from llm_d_kv_cache_manager_tpu.kvevents.pool import Pool, PoolConfig
 from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
     SubscriberManager,
 )
+from llm_d_kv_cache_manager_tpu.obs.trace import TRACER, use_trace
 from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
     ApplyChatTemplateRequest,
 )
@@ -209,12 +210,26 @@ class PrecisePrefixCacheScorer:
             logger.debug("request is nil; skipping scoring")
             return {}
 
+        # Sampled cycle trace: the embedded stack has no HTTP layer to
+        # ingest a traceparent, so the scheduler cycle is the trace root
+        # and the indexer's stage spans attach beneath it.
+        cycle_trace = TRACER.start_trace("scheduler.score")
+        if cycle_trace is not None:
+            cycle_trace.set_attr("model", request.target_model)
+            cycle_trace.set_attr("candidate_pods", len(pods))
         start = time.perf_counter()
         try:
-            raw = self._get_scores(request)
-        except Exception:
+            with use_trace(cycle_trace):
+                raw = self._get_scores(request)
+        except Exception as exc:
+            if cycle_trace is not None:
+                cycle_trace.set_error(repr(exc))
+                cycle_trace.finish("error")
             logger.exception("failed to get pod scores")
             return {}
+        if cycle_trace is not None:
+            cycle_trace.set_attr("scored_pods", len(raw))
+            cycle_trace.finish()
         logger.debug(
             "scored %d pods in %.1f ms",
             len(raw),

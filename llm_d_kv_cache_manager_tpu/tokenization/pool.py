@@ -18,11 +18,18 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import List, Optional
 
 from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.obs.trace import (
+    Trace,
+    current_trace,
+    span as obs_span,
+    use_trace,
+)
 from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
     ApplyChatTemplateRequest,
     ChatTemplatingProcessor,
@@ -62,6 +69,11 @@ class _Task:
     # fire-and-forget tasks were never pre-probed, so they keep the
     # worker-side probe.
     store_probed: bool = False
+    # Explicit trace propagation across the pool boundary: the
+    # submitting thread's active trace rides the task so worker-side
+    # spans (queue wait, chat render, encode) land on the same trace.
+    trace: Optional[Trace] = None
+    submitted_at: float = 0.0
 
 
 class TokenizationPool:
@@ -155,11 +167,13 @@ class TokenizationPool:
         """The cached token stream when store coverage clears the
         fast-path threshold; None otherwise.  Shared by the sync
         caller path and the worker (_process)."""
-        tokens, overlap_ratio = (
-            self._prefix_store.find_longest_contained_tokens(
-                prompt, model_name
+        with obs_span("tokenize.prefix_probe", parent="tokenize") as s:
+            tokens, overlap_ratio = (
+                self._prefix_store.find_longest_contained_tokens(
+                    prompt, model_name
+                )
             )
-        )
+            s.set_attr("coverage", round(overlap_ratio, 4))
         if overlap_ratio >= self.config.min_prefix_overlap_ratio:
             METRICS.tokenization_prefix_fast_path.inc()
             trace(
@@ -184,6 +198,9 @@ class TokenizationPool:
         self, prompt, model_name, render_req, future, store_probed=False
     ) -> None:
         self.start()
+        # Waiting callers (future set) carry their trace to the worker;
+        # fire-and-forget warmers are not request-scoped.
+        task_trace = current_trace() if future is not None else None
         self._queue.put(
             _Task(
                 prompt=prompt,
@@ -191,6 +208,10 @@ class TokenizationPool:
                 render_req=render_req,
                 future=future,
                 store_probed=store_probed,
+                trace=task_trace,
+                submitted_at=(
+                    time.perf_counter() if task_trace is not None else 0.0
+                ),
             )
         )
 
@@ -205,6 +226,12 @@ class TokenizationPool:
                 self._queue.task_done()
 
     def _run_task(self, task: _Task) -> None:
+        # Queue wait recorded once, before the retry loop (retries are
+        # worker-inline, not re-queued).
+        if task.trace is not None:
+            task.trace.add_completed(
+                "tokenize.queue_wait", task.submitted_at, parent="tokenize"
+            )
         # Retries run inline on this worker: re-enqueueing would block on a
         # full queue (deadlocking the pool under backend outage) and could
         # strand the task behind shutdown sentinels with its future
@@ -235,14 +262,22 @@ class TokenizationPool:
             return
 
     def _process(self, task: _Task) -> List[int]:
+        # Re-enter the submitter's trace on this worker thread so stage
+        # spans (template, probe, encode) attach to the request.
+        with use_trace(task.trace):
+            return self._process_in_context(task)
+
+    def _process_in_context(self, task: _Task) -> List[int]:
         prompt = task.prompt
         # vLLM adds special tokens to raw completion prompts but not to
         # chat-rendered ones (the template already placed them).
         add_special_tokens = True
         if task.render_req is not None:
-            prompt = self._chat_processor.apply_chat_template(
-                task.model_name, task.render_req
-            )
+            with obs_span("tokenize.chat_template", parent="tokenize") as s:
+                prompt = self._chat_processor.apply_chat_template(
+                    task.model_name, task.render_req
+                )
+                s.set_attr("rendered_chars", len(prompt))
             add_special_tokens = False
 
         if not task.store_probed:
@@ -250,9 +285,11 @@ class TokenizationPool:
             if served is not None:
                 return served
 
-        encoding = self._tokenizer.encode(
-            prompt, task.model_name, add_special_tokens
-        )
+        with obs_span("tokenize.encode", parent="tokenize") as s:
+            encoding = self._tokenizer.encode(
+                prompt, task.model_name, add_special_tokens
+            )
+            s.set_attr("tokens", len(encoding.tokens))
         self._prefix_store.add_tokenization(
             prompt, encoding.tokens, encoding.offsets, task.model_name
         )

@@ -1,0 +1,195 @@
+"""/metrics endpoint + collector-helper coverage.
+
+Asserts the Prometheus exposition contract through the booted HTTP
+service: content type, that a scoring request moves
+``index_lookup_requests``, that a traced request materializes the
+``kvtpu_stage_latency_seconds`` histogram with the expected stage
+label values, and that ``tokenization_latency`` carries sub-millisecond
+buckets.  Also pins the ``counter_total``/``gauge_value`` helpers that
+the metrics beat relies on (the old ``collect()[0].samples[0]`` read
+crashed on labeled counters).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import tempfile
+import time
+import urllib.request
+
+import pytest
+from prometheus_client import CollectorRegistry, Counter, Gauge
+
+from llm_d_kv_cache_manager_tpu.api.http_service import serve
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.metrics.collector import (
+    METRICS,
+    counter_total,
+    gauge_value,
+    start_metrics_logging,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPoolConfig,
+)
+from tests.helpers.tiny_tokenizer import save_tokenizer_json
+
+MODEL = "test-model"
+BLOCK_SIZE = 4
+PROMPT = "the quick brown fox jumps over the lazy dog . " * 8
+SAMPLED_TP = "00-" + "1f" * 16 + "-" + "2d" * 8 + "-01"
+
+
+@pytest.fixture()
+def service():
+    tokenizer_dir = save_tokenizer_json(tempfile.mkdtemp(), MODEL)
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE
+            ),
+            # InstrumentedIndex wrapper: lookups feed the counters.
+            kvblock_index_config=IndexConfig(enable_metrics=True),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                workers=2, model_name=MODEL
+            ),
+            # Composite tokenizer via auto-discovery so the real
+            # tokenization_latency{tokenizer=...} path is exercised.
+            local_tokenizers_dir=tokenizer_dir,
+        )
+    )
+    indexer.run()
+    server = serve(indexer, host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield base
+    server.shutdown()
+    indexer.shutdown()
+
+
+def fetch_metrics(base):
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as response:
+        return response.headers.get("Content-Type"), response.read().decode()
+
+
+def score(base, headers=None):
+    request = urllib.request.Request(
+        base + "/score_completions",
+        data=json.dumps({"prompt": PROMPT, "model": MODEL}).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(request, timeout=10) as response:
+        return json.load(response)
+
+
+def sample_value(text, name, label_substr=""):
+    """Sum of exposition samples matching name (+ label substring)."""
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        if line.startswith(name) and label_substr in line:
+            total += float(line.rsplit(" ", 1)[1])
+            seen = True
+    return total if seen else None
+
+
+class TestMetricsEndpoint:
+    def test_exposition_content_type(self, service):
+        content_type, _ = fetch_metrics(service)
+        assert content_type.startswith("text/plain")
+        assert "version=0.0.4" in content_type
+
+    def test_scoring_request_moves_lookup_counter(self, service):
+        name = "kvtpu_kvcache_index_lookup_requests_total"
+        _, before_text = fetch_metrics(service)
+        before = sample_value(before_text, name) or 0.0
+        score(service)
+        _, after_text = fetch_metrics(service)
+        assert sample_value(after_text, name) == before + 1
+
+    def test_stage_histogram_appears_with_stage_labels(self, service):
+        name = "kvtpu_stage_latency_seconds_count"
+        _, before_text = fetch_metrics(service)
+        before = {
+            stage: sample_value(before_text, name, f'stage="{stage}"')
+            or 0.0
+            for stage in ("tokenize", "hash_blocks", "index_lookup", "score")
+        }
+        # A sampled traceparent forces the trace that feeds the
+        # histogram regardless of TRACE_SAMPLE_RATE.
+        score(service, headers={"traceparent": SAMPLED_TP})
+        _, after_text = fetch_metrics(service)
+        for stage, prior in before.items():
+            observed = sample_value(after_text, name, f'stage="{stage}"')
+            assert observed == prior + 1, stage
+
+    def test_tokenization_latency_has_sub_ms_buckets(self, service):
+        score(service)
+        _, text = fetch_metrics(service)
+        bucket_lines = [
+            line
+            for line in text.splitlines()
+            if line.startswith("kvtpu_tokenization_latency_seconds_bucket")
+        ]
+        assert bucket_lines, "histogram never observed"
+        les = {
+            re.search(r'le="([^"]+)"', line).group(1)
+            for line in bucket_lines
+        }
+        # Sub-millisecond resolution (Prometheus defaults start at 5ms).
+        assert {"5e-05", "0.0001", "0.00025", "0.0005", "0.001"} <= les
+
+
+class TestCollectorHelpers:
+    def test_counter_total_sums_labeled_counter(self):
+        registry = CollectorRegistry()
+        counter = Counter(
+            "t_dropped", "d.", ("reason",), registry=registry
+        )
+        assert counter_total(counter) == 0.0  # no children yet
+        counter.labels(reason="a").inc(2)
+        counter.labels(reason="b").inc(3)
+        assert counter_total(counter) == 5.0
+
+    def test_counter_total_unlabeled(self):
+        registry = CollectorRegistry()
+        counter = Counter("t_plain", "d.", registry=registry)
+        counter.inc(4)
+        assert counter_total(counter) == 4.0
+
+    def test_gauge_value(self):
+        registry = CollectorRegistry()
+        gauge = Gauge("t_gauge", "d.", registry=registry)
+        assert gauge_value(gauge) == 0.0
+        gauge.set(17)
+        assert gauge_value(gauge) == 17.0
+
+    def test_beat_survives_labeled_counters_and_reports_drops(self):
+        """The beat line must not crash on the labeled kvevents_dropped
+        counter (the bug this satellite fixes) and must include the
+        dropped-events and journal-lag fields."""
+        METRICS.kvevents_dropped.labels(reason="queue_full").inc()
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = Capture()
+        beat_logger = logging.getLogger("kvtpu.metrics")
+        beat_logger.addHandler(handler)
+        stop = start_metrics_logging(0.05)
+        try:
+            deadline = time.time() + 5
+            while not records and time.time() < deadline:
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            beat_logger.removeHandler(handler)
+        assert records, "beat never fired"
+        assert "dropped_events=" in records[0]
+        assert "journal_lag=" in records[0]

@@ -1,0 +1,357 @@
+"""Unit + concurrency tests for obs/: tracer, spans, traceparent
+parsing, the flight recorder's three retention tiers, and the kvlint
+gate over the package.  Uses private Tracer instances (not the global
+TRACER) so tests never leak sampling state into each other.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.obs.recorder import FlightRecorder
+from llm_d_kv_cache_manager_tpu.obs.trace import (
+    Tracer,
+    TracerConfig,
+    current_trace,
+    format_traceparent,
+    parse_traceparent,
+    span as obs_span,
+    use_trace,
+)
+
+SAMPLED_TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+UNSAMPLED_TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-00"
+
+
+def make_tracer(**overrides) -> Tracer:
+    config = TracerConfig(sample_rate=1.0)
+    for key, value in overrides.items():
+        setattr(config, key, value)
+    return Tracer(config)
+
+
+class TestTraceparent:
+    def test_parse_valid_sampled(self):
+        parsed = parse_traceparent(SAMPLED_TP)
+        assert parsed is not None
+        assert parsed.trace_id == "ab" * 16
+        assert parsed.span_id == "cd" * 8
+        assert parsed.sampled
+
+    def test_parse_valid_unsampled(self):
+        parsed = parse_traceparent(UNSAMPLED_TP)
+        assert parsed is not None and not parsed.sampled
+
+    def test_parse_is_case_insensitive_and_strips(self):
+        parsed = parse_traceparent("  " + SAMPLED_TP.upper() + " ")
+        assert parsed is not None and parsed.trace_id == "ab" * 16
+
+    def test_parse_accepts_future_version_with_suffix_fields(self):
+        """W3C forward compatibility: higher versions parse by their
+        first four fields, ignoring any suffix fields."""
+        header = "01-" + "ab" * 16 + "-" + "cd" * 8 + "-01-extrafield"
+        parsed = parse_traceparent(header)
+        assert parsed == ("ab" * 16, "cd" * 8, True)
+
+    def test_parse_rejects_version_00_with_suffix(self):
+        assert parse_traceparent(SAMPLED_TP + "-extrafield") is None
+
+    @pytest.mark.parametrize(
+        "header",
+        [
+            None,
+            "",
+            "garbage",
+            "00-" + "ab" * 16 + "-" + "cd" * 8,  # missing flags
+            "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",  # non-hex
+            "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # forbidden ver
+            "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",  # zero trace id
+            "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # zero span id
+        ],
+    )
+    def test_parse_rejects(self, header):
+        assert parse_traceparent(header) is None
+
+    def test_format_roundtrip(self):
+        header = format_traceparent("ab" * 16, "cd" * 8, sampled=True)
+        parsed = parse_traceparent(header)
+        assert parsed == ("ab" * 16, "cd" * 8, True)
+
+
+class TestSampling:
+    def test_rate_zero_drops_and_counts(self):
+        tracer = make_tracer(sample_rate=0.0)
+        assert tracer.start_trace("t") is None
+        stats = tracer.stats()
+        assert stats["traces_unsampled"] == 1
+        assert stats["traces_sampled"] == 0
+
+    def test_rate_one_samples(self):
+        tracer = make_tracer()
+        assert tracer.start_trace("t") is not None
+
+    def test_sampled_traceparent_forces_at_rate_zero(self):
+        tracer = make_tracer(sample_rate=0.0)
+        trace = tracer.start_trace("t", traceparent=SAMPLED_TP)
+        assert trace is not None
+        assert trace.trace_id == "ab" * 16
+        assert trace.parent_span_id == "cd" * 8
+
+    def test_unsampled_traceparent_does_not_force(self):
+        tracer = make_tracer(sample_rate=0.0)
+        assert tracer.start_trace("t", traceparent=UNSAMPLED_TP) is None
+
+    def test_force_flag(self):
+        tracer = make_tracer(sample_rate=0.0)
+        assert tracer.start_trace("t", force=True) is not None
+
+    def test_configure_live_tunes_rate(self):
+        tracer = make_tracer(sample_rate=0.0)
+        tracer.configure(sample_rate=1.0)
+        assert tracer.start_trace("t") is not None
+        with pytest.raises(TypeError):
+            tracer.configure(ring_size=5)
+
+
+class TestTraceSpans:
+    def test_span_timing_parents_and_attrs(self):
+        tracer = make_tracer()
+        trace = tracer.start_trace("req")
+        with use_trace(trace):
+            with obs_span("tokenize") as s:
+                s.set_attr("tokens", 7)
+                time.sleep(0.005)
+            with obs_span("tokenize.encode", parent="tokenize"):
+                pass
+        trace.finish()
+        view = trace.to_dict()
+        assert view["status"] == "ok"
+        assert [s["stage"] for s in view["stages"]] == ["tokenize"]
+        spans = {s["name"]: s for s in view["spans"]}
+        assert spans["tokenize"]["attributes"] == {"tokens": 7}
+        assert spans["tokenize"]["duration_ms"] >= 5.0
+        assert spans["tokenize.encode"]["parent"] == "tokenize"
+
+    def test_untraced_span_is_null(self):
+        assert current_trace() is None
+        with obs_span("anything") as s:
+            s.set_attr("ignored", 1)  # must not raise
+
+    def test_add_completed_explicit_interval(self):
+        tracer = make_tracer()
+        trace = tracer.start_trace("req")
+        start = time.perf_counter() - 0.05
+        trace.add_completed("queue_wait", start)
+        trace.finish()
+        (stage,) = trace.stage_breakdown()
+        assert stage["stage"] == "queue_wait"
+        assert stage["duration_ms"] >= 50.0
+
+    def test_span_exception_marks_error(self):
+        tracer = make_tracer()
+        trace = tracer.start_trace("req")
+        with pytest.raises(RuntimeError):
+            with use_trace(trace), obs_span("boom"):
+                raise RuntimeError("nope")
+        trace.finish()
+        (span,) = trace.to_dict()["spans"]
+        assert span["status"] == "error"
+        assert "nope" in span["attributes"]["error"]
+
+    def test_set_error_routes_to_errored_reservoir(self):
+        tracer = make_tracer()
+        trace = tracer.start_trace("req")
+        trace.set_error("poison pill")
+        trace.finish()
+        assert trace.status == "error"
+        assert tracer.recorder.errored() == [trace]
+
+    def test_finish_is_idempotent(self):
+        tracer = make_tracer()
+        trace = tracer.start_trace("req")
+        trace.finish()
+        first = trace.duration_s
+        trace.finish()
+        assert trace.duration_s == first
+        assert tracer.recorder.stats()["recorded"] == 1
+
+    def test_finish_feeds_stage_histogram(self):
+        from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+        def histogram_count(stage):
+            for metric in METRICS.stage_latency.collect():
+                for sample in metric.samples:
+                    if (
+                        sample.name.endswith("_count")
+                        and sample.labels.get("stage") == stage
+                    ):
+                        return sample.value
+            return 0.0
+
+        before = histogram_count("uniquestage")
+        tracer = make_tracer()
+        trace = tracer.start_trace("req")
+        with use_trace(trace), obs_span("uniquestage"):
+            pass
+        trace.finish()
+        assert histogram_count("uniquestage") == before + 1
+
+    def test_use_trace_restores_context(self):
+        tracer = make_tracer()
+        outer = tracer.start_trace("outer")
+        inner = tracer.start_trace("inner")
+        with use_trace(outer):
+            with use_trace(inner):
+                assert current_trace() is inner
+            assert current_trace() is outer
+        assert current_trace() is None
+
+
+class TestFlightRecorder:
+    def test_ring_eviction(self):
+        tracer = make_tracer(ring_size=4)
+        traces = []
+        for i in range(10):
+            trace = tracer.start_trace(f"t{i}")
+            trace.finish()
+            traces.append(trace)
+        stats = tracer.recorder.stats()
+        assert stats["ring_occupancy"] == 4
+        assert stats["recorded"] == 10
+        recent = tracer.recorder.recent()
+        assert [t.name for t in recent] == ["t9", "t8", "t7", "t6"]
+        # Evicted and never slow/errored: unresolvable.
+        assert tracer.recorder.get(traces[0].trace_id) is None
+
+    def test_slow_promotion_survives_ring_eviction(self):
+        tracer = make_tracer(ring_size=2, slow_threshold_ms=0.0)
+        slow_trace = tracer.start_trace("slow")
+        time.sleep(0.002)
+        slow_trace.finish()
+        for i in range(5):
+            tracer.start_trace(f"f{i}").finish()
+        # Rolled out of the ring, still resolvable via the reservoir.
+        assert tracer.recorder.get(slow_trace.trace_id) is slow_trace
+        assert slow_trace in tracer.recorder.slow()
+
+    def test_slow_reservoir_keeps_slowest(self):
+        recorder = FlightRecorder(
+            ring_size=64, slow_keep=2, slow_threshold_ms=0.0
+        )
+
+        class Stub:
+            def __init__(self, trace_id, duration_s):
+                self.trace_id = trace_id
+                self.duration_s = duration_s
+                self.status = "ok"
+
+        for trace_id, duration in (
+            ("a", 0.010), ("b", 0.030), ("c", 0.020), ("d", 0.001),
+        ):
+            recorder.record(Stub(trace_id, duration))
+        assert [t.trace_id for t in recorder.slow()] == ["b", "c"]
+
+    def test_threshold_gates_promotion(self):
+        tracer = make_tracer(slow_threshold_ms=10_000.0)
+        tracer.start_trace("fast").finish()
+        assert tracer.recorder.stats()["slow_retained"] == 0
+
+    def test_clear(self):
+        tracer = make_tracer()
+        tracer.start_trace("t").finish()
+        tracer.reset()
+        stats = tracer.stats()
+        assert stats["recorded"] == 0
+        assert stats["ring_occupancy"] == 0
+        assert stats["traces_sampled"] == 0
+
+
+class TestConcurrency:
+    def test_parallel_traced_requests_no_lost_or_duplicated_ids(self):
+        """Acceptance gate: the flight-recorder ring under parallel
+        traced requests — every trace retrievable, every id unique."""
+        tracer = make_tracer(ring_size=1024)
+        n_threads, per_thread = 16, 25
+        errors = []
+        barrier = threading.Barrier(n_threads)
+
+        def worker(worker_index):
+            try:
+                barrier.wait(timeout=10)
+                for i in range(per_thread):
+                    trace = tracer.start_trace(
+                        f"w{worker_index}.{i}"
+                    )
+                    with use_trace(trace):
+                        with obs_span("stage_a"):
+                            pass
+                        with obs_span("stage_b"):
+                            assert current_trace() is trace
+                    trace.finish()
+            except Exception as exc:  # pragma: no cover - surfaced below
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(n_threads)
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=30)
+        assert not errors
+        total = n_threads * per_thread
+        recent = tracer.recorder.recent(limit=total)
+        ids = [t.trace_id for t in recent]
+        assert len(ids) == total
+        assert len(set(ids)) == total
+        stats = tracer.recorder.stats()
+        assert stats["recorded"] == total
+        assert tracer.stats()["traces_sampled"] == total
+        # Every trace got both spans (none torn by concurrency).
+        for trace in recent:
+            assert len(trace.to_dict()["spans"]) == 2
+
+    def test_cross_thread_span_append(self):
+        """Spans appended from a worker thread land on the same trace
+        (the tokenization-pool propagation contract)."""
+        tracer = make_tracer()
+        trace = tracer.start_trace("req")
+
+        def worker():
+            trace.add_completed(
+                "queue_wait", time.perf_counter() - 0.001
+            )
+            with trace.span("encode", parent="tokenize"):
+                pass
+
+        thread = threading.Thread(target=worker)
+        thread.start()
+        thread.join(timeout=10)
+        trace.finish()
+        assert len(trace.to_dict()["spans"]) == 2
+
+
+class TestKvlintGate:
+    def test_obs_package_is_kvlint_clean_without_baseline(self):
+        """Acceptance gate: kvlint over obs/ with zero baseline
+        entries.  --no-baseline means a future violation cannot hide
+        behind a grandfathered entry."""
+        result = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "hack.kvlint",
+                "llm_d_kv_cache_manager_tpu/obs",
+                "--no-baseline",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr

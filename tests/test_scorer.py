@@ -72,3 +72,83 @@ class TestLongestPrefixScorer:
         scorer = make_scorer()
         mapping = {1: [entry("a", "gpu"), entry("b", "cpu")]}
         assert scorer.score([1], mapping) == {"a": 1.0, "b": 0.8}
+
+
+class TestExplain:
+    """LongestPrefixScorer.explain: the ``explain=1`` provenance surface.
+
+    Invariant: explain's per-pod score always equals score()'s."""
+
+    def test_empty_keys(self):
+        assert make_scorer().explain([], {}) == {}
+
+    def test_full_chain_no_break(self):
+        scorer = make_scorer()
+        keys = [1, 2, 3]
+        mapping = {k: [entry("a")] for k in keys}
+        detail = scorer.explain(keys, mapping)
+        assert detail["a"]["score"] == 3.0
+        assert detail["a"]["blocks_matched"] == 3
+        assert detail["a"]["break_index"] is None
+        assert detail["a"]["tiers"] == {"hbm": 3}
+
+    def test_break_index_names_first_missing_block(self):
+        scorer = make_scorer()
+        keys = [1, 2, 3, 4]
+        mapping = {1: [entry("a")], 2: [entry("a")], 4: [entry("a")]}
+        detail = scorer.explain(keys, mapping)
+        assert detail["a"]["blocks_matched"] == 2
+        assert detail["a"]["break_index"] == 2  # block index 2 missing
+
+    def test_pod_absent_from_block_zero_omitted(self):
+        scorer = make_scorer()
+        mapping = {1: [entry("a")], 2: [entry("a"), entry("b")]}
+        detail = scorer.explain([1, 2], mapping)
+        assert "b" not in detail
+
+    def test_tier_attribution_per_block(self):
+        scorer = make_scorer()
+        keys = [1, 2, 3]
+        mapping = {
+            1: [entry("a", "hbm")],
+            2: [entry("a", "host")],
+            3: [entry("a", "host"), entry("a", "hbm")],
+        }
+        detail = scorer.explain(keys, mapping)
+        # Max-weight tier wins per block: hbm, host, hbm.
+        assert detail["a"]["tiers"] == {"hbm": 2, "host": 1}
+        assert detail["a"]["score"] == 1.0 + 0.8 + 1.0
+
+    def test_divergent_break_points_across_pods(self):
+        scorer = make_scorer()
+        keys = [1, 2, 3]
+        mapping = {
+            1: [entry("a"), entry("b")],
+            2: [entry("a")],
+            3: [entry("a")],
+        }
+        detail = scorer.explain(keys, mapping)
+        assert detail["a"]["break_index"] is None
+        assert detail["b"]["break_index"] == 1
+        assert detail["b"]["blocks_matched"] == 1
+
+    def test_explain_scores_always_match_score(self):
+        import random
+
+        rng = random.Random(7)
+        scorer = make_scorer()
+        tiers = ["hbm", "host", "shared_storage", "gpu", "cpu"]
+        for _ in range(50):
+            keys = list(range(rng.randint(0, 12)))
+            mapping = {}
+            for k in keys:
+                if rng.random() < 0.8:
+                    mapping[k] = [
+                        entry(f"p{rng.randint(0, 3)}", rng.choice(tiers))
+                        for _ in range(rng.randint(1, 3))
+                    ]
+            expected = scorer.score(keys, mapping)
+            detail = scorer.explain(keys, mapping)
+            assert {
+                pod: d["score"] for pod, d in detail.items()
+            } == expected
