@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint test unit-test e2e-test examples obs-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint test unit-test e2e-test examples obs-smoke perf-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -50,6 +50,12 @@ examples:
 # /debug/traces retrieval, explain=1, /healthz block.
 obs-smoke:
 	$(PYTHON) hack/verify_observability.py
+
+# Read-path perf smoke (same invocation as CI's "Read-path perf
+# smoke" step): a few seconds of the bench's read_path regime on CPU,
+# asserting sane output + fast-lane score parity (docs/performance.md).
+perf-smoke:
+	$(CPU_ENV) $(PYTHON) hack/perf_smoke.py
 
 # Fleet-routing benchmark; on TPU hardware drop JAX_PLATFORMS.
 bench:

@@ -1,0 +1,69 @@
+"""Read-path perf smoke: a few seconds of the bench's read_path regime.
+
+CI gate (`make perf-smoke`): runs the scoring read path (tokenize ->
+hash -> lookup -> score) for real on CPU at tiny geometry and asserts
+the regime completes with sane output — every workload cell produced a
+positive scores/sec, and the fast-lane parity check passed (identical
+scores with READ_PATH_FAST_LANE semantics on vs off).  This is a
+smoke/regression gate for the machinery, deliberately NOT a performance
+assertion: CI boxes are noisy, so thresholds on absolute numbers would
+flake.  See docs/performance.md for the regime and its knobs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    # Tiny geometry + CPU platform must be pinned BEFORE bench import
+    # (bench.py reads both at module scope).
+    os.environ.setdefault("KVTPU_BENCH_TINY", "1")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("KVTPU_BENCH_PLATFORM", "cpu")
+
+    # bench.py lives at the repo root, one level above hack/.
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench
+
+    cell_s = float(os.environ.get("PERF_SMOKE_CELL_S", "0.8"))
+    result = bench.bench_read_path(cell_seconds=cell_s)
+    print(json.dumps(result, indent=2))
+
+    failures = []
+    for cell in (
+        "warm_multi_turn",
+        "warm_multi_turn_no_memo",
+        "cold",
+        "mixed",
+        "warm_multi_turn_fastlane_off",
+        "cold_fastlane_off",
+    ):
+        stats = result.get(cell) or {}
+        if not stats.get("scores_per_sec", 0) > 0:
+            failures.append(f"{cell}: scores_per_sec not > 0 ({stats})")
+        if not stats.get("p50_us", 0) > 0:
+            failures.append(f"{cell}: p50_us not > 0 ({stats})")
+    if result.get("parity") != "ok":
+        failures.append(
+            f"fast-lane parity check failed: {result.get('parity')!r}"
+        )
+    if failures:
+        print("PERF SMOKE FAILED:", file=sys.stderr)
+        for failure in failures:
+            print(f"  - {failure}", file=sys.stderr)
+        return 1
+    warm = result["warm_multi_turn"]["scores_per_sec"]
+    print(
+        f"perf smoke ok: warm {warm}/s, parity {result['parity']}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -498,6 +498,7 @@ def main() -> None:  # pragma: no cover - CLI entry
     from llm_d_kv_cache_manager_tpu.kvcache.indexer import IndexerConfig
     from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
         IndexConfig,
+        InMemoryIndexConfig,
         RedisIndexConfig,
     )
     from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
@@ -522,6 +523,11 @@ def main() -> None:  # pragma: no cover - CLI entry
         kvblock_index_config=IndexConfig(
             enable_metrics=os.environ.get("ENABLE_METRICS", "true").lower()
             != "false",
+            # Lock stripes for the in-memory backend (ignored for
+            # Redis); power of two, docs/performance.md.
+            in_memory_config=InMemoryIndexConfig(
+                shards=int(os.environ.get("INDEX_SHARDS", "8"))
+            ),
             # e.g. INDEX_BACKEND=valkey://valkey:6379 selects the shared
             # distributed index; unset keeps the in-memory backend.
             redis_config=(
@@ -543,6 +549,11 @@ def main() -> None:  # pragma: no cover - CLI entry
         ),
         local_tokenizers_dir=os.environ.get("LOCAL_TOKENIZER_DIR") or None,
         uds_tokenizer_path=os.environ.get("UDS_TOKENIZER_PATH") or None,
+        # read_path_fast_lane stays None here: the Indexer resolves the
+        # READ_PATH_FAST_LANE env knob itself (docs/performance.md).
+        lookup_chunk_size=int(
+            os.environ.get("READ_PATH_LOOKUP_CHUNK", "32")
+        ),
     )
     indexer = Indexer(config)
     indexer.run()
@@ -578,7 +589,10 @@ def main() -> None:  # pragma: no cover - CLI entry
         indexer.kv_block_index,
         indexer.token_processor,
         PoolConfig(
-            concurrency=int(os.environ.get("POOL_CONCURRENCY", "4"))
+            concurrency=int(os.environ.get("POOL_CONCURRENCY", "4")),
+            apply_batch_size=int(
+                os.environ.get("KVEVENTS_APPLY_BATCH", "32")
+            ),
         ),
         journal=persistence.journal if persistence else None,
     )

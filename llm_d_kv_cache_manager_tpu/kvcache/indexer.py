@@ -12,10 +12,22 @@ pkg/kvcache/indexer.go:124-165):
 
 One ``Config`` composes every module's config with defaults, so embedding
 applications construct the whole stack from a single literal.
+
+Read-path fast lane (docs/performance.md): by default ``get_pod_scores``
+runs a chunked drive of the stack — the prefix store returns memoized
+block keys alongside tokens (a multi-turn conversation only hashes its
+new suffix), and hashing + index lookups proceed in chunks that stop as
+soon as the prefix chain is dead for every candidate pod (an 8k-token
+cold prompt stops paying for its unreachable suffix).  Scores are
+bit-identical to the straight-line path (pinned by property tests);
+``READ_PATH_FAST_LANE=0`` or ``IndexerConfig.read_path_fast_lane=False``
+restores the straight-line path.
 """
 
 from __future__ import annotations
 
+import os
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -35,7 +47,10 @@ from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
     ScorerConfig,
     new_scorer,
 )
-from llm_d_kv_cache_manager_tpu.obs.trace import span as obs_span
+from llm_d_kv_cache_manager_tpu.obs.trace import (
+    current_trace,
+    span as obs_span,
+)
 from llm_d_kv_cache_manager_tpu.preprocessing.chat_templating import (
     ApplyChatTemplateRequest,
     ChatTemplatingProcessor,
@@ -58,6 +73,66 @@ from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
 
 logger = get_logger("kvcache.indexer")
 
+# Block keys hashed + looked up per fast-lane round trip; the early-exit
+# granularity (a dead chain stops within one chunk of the break).
+DEFAULT_LOOKUP_CHUNK = 32
+
+# Entries in the request score memo (exact-prompt results validated by
+# the index's per-shard version vector); 0 disables.
+DEFAULT_SCORE_MEMO = 256
+
+
+def _env_fast_lane_default() -> Optional[bool]:
+    raw = os.environ.get("READ_PATH_FAST_LANE")
+    if raw is None:
+        return None
+    return raw.strip().lower() not in ("0", "false", "off")
+
+
+def _env_score_memo_default() -> Optional[int]:
+    """READ_PATH_SCORE_MEMO: "0"/"false"/"off" disables, a positive
+    integer sizes the memo, unset defers to the config default."""
+    raw = os.environ.get("READ_PATH_SCORE_MEMO")
+    if raw is None:
+        return None
+    text = raw.strip().lower()
+    if text in ("0", "false", "off"):
+        return 0
+    try:
+        return max(0, int(text))
+    except ValueError:
+        return DEFAULT_SCORE_MEMO
+
+
+class _ScoreMemoEntry:
+    """One memoized scoring result: the scores computed by a full
+    fast-lane walk, the two validators that prove a re-walk would
+    reproduce them — the index version vector captured BEFORE that walk
+    (equal vectors at hit time mean no score-relevant mutation landed
+    since) and the exact token stream tokenization served the walk
+    (compared by value: a prefix-store chunk overwritten by an
+    overlapping prompt's different split can change the served token
+    VALUES while preserving their count, and stale tokens mean stale
+    block keys) — and the chain keys the walk consumed (touched on
+    every hit so LRU recency, hence eviction order, stays identical to
+    the walk the memo elides)."""
+
+    __slots__ = ("scores", "version", "tokens", "touch_keys", "max_pod_hits")
+
+    def __init__(
+        self,
+        scores: Dict[str, float],
+        version: tuple,
+        tokens: tuple,
+        touch_keys: tuple,
+        max_pod_hits: int,
+    ) -> None:
+        self.scores = scores
+        self.version = version
+        self.tokens = tokens
+        self.touch_keys = touch_keys
+        self.max_pod_hits = max_pod_hits
+
 
 @dataclass
 class IndexerConfig:
@@ -76,6 +151,21 @@ class IndexerConfig:
     # disables that backend.  Composite order mirrors the reference's
     # local -> uds -> hf fallback chain (pkg/tokenization/pool.go:97-145).
     uds_tokenizer_path: Optional[str] = None
+    # Read-path fast lane (memoized block keys + chunked early-exit
+    # lookup).  None resolves from READ_PATH_FAST_LANE (default on);
+    # scores are identical either way (docs/performance.md).
+    read_path_fast_lane: Optional[bool] = None
+    # Keys hashed + looked up per fast-lane chunk.
+    lookup_chunk_size: int = DEFAULT_LOOKUP_CHUNK
+    # Entries in the request score memo (fast lane only): a repeat of
+    # an exact prompt returns its memoized scores when the index's
+    # per-shard version vector is unchanged since they were computed —
+    # any add/evict/purge/restore invalidates.  0 disables; None
+    # resolves from READ_PATH_SCORE_MEMO (default 256).  Requires an
+    # index backend exposing version_vector/touch_chain (the in-memory
+    # backend; others silently run without the memo).  Entries pin
+    # their prompt strings, so memory is O(size x prompt length).
+    score_memo_size: Optional[int] = None
 
 
 class Indexer:
@@ -100,6 +190,66 @@ class Indexer:
         )
         self.prefix_store = LRUTokenStore(self.config.prefix_store_config)
         self.chat_processor = chat_processor or ChatTemplatingProcessor()
+
+        fast_lane = self.config.read_path_fast_lane
+        if fast_lane is None:
+            env_default = _env_fast_lane_default()
+            fast_lane = True if env_default is None else env_default
+        if fast_lane and not (
+            hasattr(self.token_processor, "block_size")
+            and callable(
+                getattr(self.token_processor, "extend_block_keys", None)
+            )
+        ):
+            # A custom TokenProcessor only promises the Protocol
+            # (tokens_to_kv_block_keys); the fast lane needs the
+            # chunked-resume surface, so fall back to the straight
+            # path rather than crash on the first request.
+            logger.info(
+                "token processor %s lacks the fast-lane surface "
+                "(block_size/extend_block_keys); using the straight "
+                "read path",
+                type(self.token_processor).__name__,
+            )
+            fast_lane = False
+        self._fast_lane = fast_lane
+        if self.config.lookup_chunk_size <= 0:
+            raise ValueError("lookup_chunk_size must be positive")
+        self._lookup_chunk = self.config.lookup_chunk_size
+        # Hash-space identity for block-key memoization; None when the
+        # token processor does not expose one (custom TokenProcessor
+        # implementations) — the fast lane then runs without memo.
+        self._key_space = getattr(self.token_processor, "key_space", None)
+        # A metrics-wrapped index records lookups per call; the fast
+        # lane makes one call per chunk, so it records ONE
+        # request-granular observation itself instead (see
+        # InstrumentedIndex.record_chain_lookup).
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import (
+            InstrumentedIndex,
+        )
+
+        self._record_chain_lookup = (
+            InstrumentedIndex.record_chain_lookup
+            if isinstance(self.kv_block_index, InstrumentedIndex)
+            else None
+        )
+
+        memo_size = self.config.score_memo_size
+        if memo_size is None:
+            env_memo = _env_score_memo_default()
+            memo_size = DEFAULT_SCORE_MEMO if env_memo is None else env_memo
+        from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
+
+        self._score_memo: Optional[LRUCache] = None
+        if (
+            self._fast_lane
+            and memo_size > 0
+            and callable(
+                getattr(self.kv_block_index, "version_vector", None)
+            )
+            and callable(getattr(self.kv_block_index, "touch_chain", None))
+        ):
+            self._score_memo = LRUCache(memo_size)
 
         if tokenizer is None:
             backends: List[Tokenizer] = []
@@ -138,9 +288,11 @@ class Indexer:
         model_name: str,
         render_req: Optional[ApplyChatTemplateRequest],
     ) -> Tuple[List[int], List[int]]:
-        """Shared front half of the read path: prompt -> tokens -> chained
-        block keys, with per-stage spans when a trace is active (the
-        tokenization pool adds its own sub-spans under "tokenize")."""
+        """Straight-line front half of the read path: prompt -> tokens
+        -> chained block keys, with per-stage spans when a trace is
+        active (the tokenization pool adds its own sub-spans under
+        "tokenize").  Used by the explain surface and by
+        ``get_pod_scores`` when the fast lane is disabled."""
         with obs_span("tokenize") as s:
             tokens = self.tokenization_pool.tokenize(
                 prompt, model_name, render_req
@@ -168,6 +320,25 @@ class Indexer:
         ``pod_identifiers`` filters the result; None/empty scores every pod
         the index knows about.
         """
+        if self._fast_lane:
+            return self._get_pod_scores_fast(
+                prompt, model_name, pod_identifiers, render_req
+            )
+        return self._get_pod_scores_straight(
+            prompt, model_name, pod_identifiers, render_req
+        )
+
+    def _get_pod_scores_straight(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Optional[Sequence[str]] = None,
+        render_req: Optional[ApplyChatTemplateRequest] = None,
+    ) -> Dict[str, float]:
+        """The pre-fast-lane path: hash every block, one lookup, one
+        scoring pass.  Kept verbatim as the parity oracle
+        (READ_PATH_FAST_LANE=0) and the fallback when the fast lane is
+        configured off."""
         _, block_keys = self._tokens_and_block_keys(
             prompt, model_name, render_req
         )
@@ -186,6 +357,219 @@ class Indexer:
         )
         return scores
 
+    def _get_pod_scores_fast(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Optional[Sequence[str]] = None,
+        render_req: Optional[ApplyChatTemplateRequest] = None,
+    ) -> Dict[str, float]:
+        """The fast lane: memoized prefix keys + chunked early-exit
+        hashing/lookup/scoring, fronted by the request score memo.
+        Identical scores to the straight path
+        (tests/test_read_path_fastlane.py pins it)."""
+        memo = self._score_memo
+        memo_key = None
+        if memo is not None and render_req is None:
+            memo_key = (
+                prompt,
+                model_name,
+                tuple(pod_identifiers) if pod_identifiers else None,
+            )
+        with obs_span("tokenize") as s:
+            result = self.tokenization_pool.tokenize_with_keys(
+                prompt, model_name, render_req, self._key_space
+            )
+            s.set_attr("tokens", len(result.tokens))
+
+        tokens = result.tokens
+        block_size = self.token_processor.block_size
+        total_blocks = len(tokens) // block_size
+        if total_blocks == 0:
+            return {}
+
+        memo_keys = result.memo_keys
+        memo_blocks = min(len(memo_keys), total_blocks)
+        pod_set = set(pod_identifiers) if pod_identifiers else None
+
+        index = self.kv_block_index
+        if memo_key is not None and current_trace() is None:
+            # Exact-prompt score memo, validated optimistically: the
+            # memoized result is served only when (1) tokenization
+            # served the exact token stream the walk that computed it
+            # saw (count alone is not enough — an overlapping prompt's
+            # add_tokenization can re-split a shared prefix-store chunk
+            # to different token values with the same count, and
+            # different tokens mean different block keys) and (2) the
+            # index's per-shard version vector is unchanged since that
+            # walk began (no score-relevant mutation landed).  Traced
+            # requests always walk, so sampled traces carry real stage
+            # spans.
+            hit = memo.get(memo_key)
+            if (
+                hit is not None
+                and len(hit.tokens) == len(tokens)
+                and hit.version == index.version_vector()
+                and list(hit.tokens) == tokens
+            ):
+                index.touch_chain(hit.touch_keys)
+                if self._record_chain_lookup is not None:
+                    self._record_chain_lookup(0.0, hit.max_pod_hits)
+                logger.debug(
+                    "score-memo hit: %d pods over %d chain keys",
+                    len(hit.scores),
+                    len(hit.touch_keys),
+                )
+                return dict(hit.scores)
+        processor = self.token_processor
+        scorer = self.scorer
+        chain = scorer.begin()
+        chunk_size = self._lookup_chunk
+        perf = time.perf_counter
+
+        hash_s = 0.0
+        lookup_s = 0.0
+        score_s = 0.0
+        keys_hit = 0
+        record_lookup = self._record_chain_lookup
+        hits_per_pod: Dict[str, int] = {}
+        parent_key = (
+            memo_keys[memo_blocks - 1] if memo_blocks else EMPTY_BLOCK_HASH
+        )
+        keys_done: List[int] = []
+        touched_keys: List[int] = []
+        # Captured BEFORE the first lookup: a mutation landing anywhere
+        # during the walk bumps past this vector, so the memoized result
+        # can never validate against post-mutation state.
+        memo_version = (
+            index.version_vector() if memo_key is not None else None
+        )
+        position = 0  # blocks consumed
+        alive = True
+        while position < total_blocks and alive:
+            t_0 = perf()
+            if position < memo_blocks:
+                # The memoized prefix needs no hashing, so early exit
+                # saves nothing there: drive it as ONE chunk (one
+                # grouped lock pass over the whole prefix).
+                key_chunk: Sequence[int] = (
+                    memo_keys[:memo_blocks]
+                    if position == 0 and memo_blocks == len(memo_keys)
+                    else memo_keys[position:memo_blocks]
+                )
+            else:
+                n_blocks = min(chunk_size, total_blocks - position)
+                suffix = tokens[
+                    position * block_size : (position + n_blocks) * block_size
+                ]
+                key_chunk = processor.extend_block_keys(
+                    parent_key, suffix, model_name
+                )
+                parent_key = key_chunk[-1] if key_chunk else parent_key
+                # Hash chunks double up to the cap: early exit stays
+                # fine-grained near the front of a cold chain (where
+                # breaks live) while a long live suffix amortizes the
+                # per-chunk overhead.
+                if chunk_size < 512:
+                    chunk_size *= 2
+            t_1 = perf()
+            hash_s += t_1 - t_0
+            keys_done.extend(key_chunk)
+            pods_per_key = index.lookup_chain(key_chunk)
+            t_2 = perf()
+            lookup_s += t_2 - t_1
+            keys_hit += len(pods_per_key)
+            if memo_key is not None and pods_per_key:
+                touched_keys.extend(key_chunk[: len(pods_per_key)])
+            if record_lookup is not None:
+                # Tally over the FILTERED view (what the straight
+                # path's instrumented lookup counts): a non-candidate
+                # pod's residency must not move the hit metrics.  One
+                # knowing divergence: the tally covers only the chain
+                # actually driven, so residency past the point where
+                # the chain died for every candidate (which early exit
+                # never looks up, and which cannot move any score) is
+                # not counted, while the straight path's full lookup
+                # would count it (docs/performance.md).
+                for pods in pods_per_key:
+                    for entry in pods:
+                        pod_id = entry.pod_identifier
+                        if pod_set is not None and pod_id not in pod_set:
+                            continue
+                        hits_per_pod[pod_id] = (
+                            hits_per_pod.get(pod_id, 0) + 1
+                        )
+            alive = (
+                scorer.advance(chain, pods_per_key, pod_set)
+                and len(pods_per_key) == len(key_chunk)
+            )
+            score_s += perf() - t_2
+            position += len(key_chunk)
+
+        if (
+            self._key_space is not None
+            and len(keys_done) > memo_blocks
+            and result.text
+        ):
+            # New keys were hashed: memoize them on the prompt's chunk
+            # chain so the next request over this prefix resumes
+            # instead of re-hashing (advisory; evictions only cost a
+            # re-hash).  min_blocks skips re-writing the records the
+            # memo was resumed from — only the new suffix's chunks pay.
+            self.prefix_store.attach_block_keys(
+                result.text,
+                model_name,
+                self._key_space,
+                keys_done,
+                tokens,
+                min_blocks=memo_blocks,
+            )
+
+        max_pod_hits = max(hits_per_pod.values()) if hits_per_pod else 0
+        if record_lookup is not None:
+            record_lookup(lookup_s, max_pod_hits)
+
+        if memo_key is not None:
+            memo.put(
+                memo_key,
+                _ScoreMemoEntry(
+                    dict(chain.scores),
+                    memo_version,
+                    tuple(tokens),
+                    tuple(touched_keys),
+                    max_pod_hits,
+                ),
+            )
+
+        tracer = current_trace()
+        if tracer is not None:
+            # One span per pipeline stage (the stage vocabulary the
+            # metrics histogram and the debug surface share), durations
+            # accumulated across chunks and emitted as contiguous
+            # intervals ending now.
+            end = perf()
+            span = tracer.add_completed(
+                "hash_blocks", end - hash_s - lookup_s - score_s,
+                end - lookup_s - score_s,
+            )
+            span.set_attr("block_keys", len(keys_done))
+            span.set_attr("memo_blocks", memo_blocks)
+            span = tracer.add_completed(
+                "index_lookup", end - lookup_s - score_s, end - score_s
+            )
+            span.set_attr("keys_hit", keys_hit)
+            span = tracer.add_completed("score", end - score_s, end)
+            span.set_attr("pods", len(chain.scores))
+        logger.debug(
+            "fast-lane scored %d pods over %d/%d block keys "
+            "(%d memoized)",
+            len(chain.scores),
+            len(keys_done),
+            total_blocks,
+            memo_blocks,
+        )
+        return chain.scores
+
     def get_pod_scores_explained(
         self,
         prompt: str,
@@ -200,8 +584,9 @@ class Indexer:
         counts and, per pod, blocks matched, the block index where the
         consecutive-prefix chain broke, and per-tier hit counts (see
         ``LongestPrefixScorer.explain``).  The debug surface — slower
-        than the hot path by the explain bookkeeping; not for every
-        request.
+        than the hot path by the explain bookkeeping (and it always
+        walks the full chain: break indices need the straight-line
+        path, never the early-exit fast lane); not for every request.
         """
         tokens, block_keys = self._tokens_and_block_keys(
             prompt, model_name, render_req

@@ -155,3 +155,53 @@ def encode_hash_payload(
             out += _head(0, token)
     _encode_into(extra, out)
     return bytes(out)
+
+
+# ---- chunk-payload fast path (the pure-Python hash hot loop) ----------
+#
+# Every link of a block-hash chain encodes ``[parent, chunk_tokens,
+# null]`` where parent is a uint64 and the token-list length equals the
+# configured block size — so the array head, the 9-byte parent head
+# shape, the token-list head, and the trailing null are invariant
+# framing that `encode_hash_payload` re-derived per chunk through
+# generic dispatch.  `encode_chunk_payload` precomputes the invariant
+# pieces and inlines shortest-form uint heads for the tokens; output is
+# bit-identical to ``encode_hash_payload(parent, tokens, None)``
+# (pinned by tests/test_read_path_fastlane.py against the generic
+# encoder and the golden chain vectors).  It returns a ``bytearray`` so
+# the caller can hash it without a defensive ``bytes`` copy.
+
+_TOKENS_HEAD_CACHE: dict = {}
+
+
+def _tokens_head(n: int) -> bytes:
+    head = _TOKENS_HEAD_CACHE.get(n)
+    if head is None:
+        head = _head(4, n)
+        _TOKENS_HEAD_CACHE[n] = head
+    return head
+
+
+def encode_chunk_payload(parent: int, tokens: Sequence[int]) -> bytearray:
+    """``[parent, tokens, null]`` as canonical CBOR, framing precomputed."""
+    out = bytearray(b"\x83")  # array(3), invariant
+    if parent < 24:
+        out.append(parent)
+    else:
+        out += _head(0, parent)
+    out += _tokens_head(len(tokens))
+    pack = struct.pack
+    for token in tokens:
+        if token < 24:
+            out.append(token)
+        elif token < 0x100:
+            out.append(0x18)
+            out.append(token)
+        elif token < 0x10000:
+            out += pack(">BH", 0x19, token)
+        elif token < 0x100000000:
+            out += pack(">BI", 0x1A, token)
+        else:
+            out += _head(0, token)
+    out.append(0xF6)  # null extra, invariant
+    return out

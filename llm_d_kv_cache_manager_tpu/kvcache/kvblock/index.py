@@ -66,6 +66,30 @@ class Index(ABC):
         chain is broken there for every pod).
         """
 
+    def lookup_chain(
+        self, request_keys: Sequence[int]
+    ) -> List[Sequence[PodEntry]]:
+        """Aligned per-key pod entries for a consecutive prefix chain.
+
+        The read-path fast lane's lookup shape: ``result[i]`` holds the
+        (unfiltered) pods for ``request_keys[i]``; the walk stops at
+        the first key with no resident pods, so the result may be
+        shorter than the input — a truncated result means the prefix
+        chain is dead there for every pod.  Pod filtering happens in
+        the scorer (``LongestPrefixScorer.advance``), which never
+        changes scores relative to ``lookup`` + ``score`` (pinned by
+        the fast-lane parity tests).  Backends may override with a
+        dict-free implementation; this default adapts :meth:`lookup`.
+        """
+        found = self.lookup(request_keys, None)
+        out: List[Sequence[PodEntry]] = []
+        for key in request_keys:
+            pods = found.get(key)
+            if not pods:
+                break
+            out.append(pods)
+        return out
+
     @abstractmethod
     def add(
         self,
@@ -143,6 +167,13 @@ class InMemoryIndexConfig:
     size: int = 100_000_000
     # Maximum pod entries tracked per key.
     pod_cache_size: int = 10
+    # Lock stripes for the request-key map (rounded up to a power of
+    # two).  Concurrent scoring reads and kvevents applies touching
+    # different shards never share a lock; capacity is budgeted per
+    # shard, so the global ``size`` bound is approximate unless
+    # ``shards=1`` (exact single-LRU semantics).  See
+    # docs/performance.md.
+    shards: int = 8
 
 
 @dataclass

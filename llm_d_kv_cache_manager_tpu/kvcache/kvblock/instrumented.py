@@ -46,6 +46,31 @@ class InstrumentedIndex(Index):
                 METRICS.index_max_pod_hits.inc(max(hits_per_pod.values()))
         return result
 
+    def lookup_chain(
+        self, request_keys: Sequence[int]
+    ) -> List[Sequence[PodEntry]]:
+        # Deliberately un-instrumented: the fast lane calls this once
+        # per CHUNK, and counting per call would silently inflate the
+        # lookup counters relative to the straight path (one logical
+        # lookup per scoring request).  The Indexer records one
+        # request-granularity observation per chunked drive instead
+        # (record_chain_lookup below).
+        return self._inner.lookup_chain(request_keys)
+
+    @staticmethod
+    def record_chain_lookup(
+        latency_s: float, max_pod_hits: int
+    ) -> None:
+        """One scoring request's chunked lookup, request-granular —
+        the same meaning lookup() records per call: requests +1, hits
+        +1 when any pod matched, total lookup latency, and the max
+        per-pod hit count across the whole chain."""
+        METRICS.index_lookup_requests.inc()
+        METRICS.index_lookup_latency.observe(latency_s)
+        if max_pod_hits:
+            METRICS.index_lookup_hits.inc()
+            METRICS.index_max_pod_hits.inc(max_pod_hits)
+
     def add(
         self,
         engine_keys: Sequence[int],
@@ -54,6 +79,30 @@ class InstrumentedIndex(Index):
     ) -> None:
         self._inner.add(engine_keys, request_keys, entries)
         METRICS.index_admissions.inc(len(request_keys))
+
+    # Batched-apply capability passthrough: kvevents/pool.py probes for
+    # add_mappings/add_entries_batch with getattr, so the wrapper must
+    # neither mask a backend that has them nor fake them on a backend
+    # that does not — hence __getattr__ (which only fires for names NOT
+    # defined on this class) instead of plain methods.
+
+    def __getattr__(self, name: str):
+        if name in ("add_mappings", "version_vector", "touch_chain"):
+            # version_vector/touch_chain: the indexer's score memo
+            # probes for the optimistic-validation surface the same
+            # way (getattr), and neither needs metrics of its own.
+            return getattr(self._inner, name)
+        if name == "add_entries_batch":
+            inner_batch = getattr(self._inner, name)
+
+            def add_entries_batch(items) -> None:
+                inner_batch(items)
+                METRICS.index_admissions.inc(
+                    sum(len(request_keys) for request_keys, _ in items)
+                )
+
+            return add_entries_batch
+        raise AttributeError(name)
 
     def evict(self, engine_key: int, entries: Sequence[PodEntry]) -> None:
         self._inner.evict(engine_key, entries)

@@ -24,9 +24,10 @@ always-available reference implementation and the parity oracle for tests.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Protocol, Sequence
+from typing import List, Optional, Protocol, Sequence, Tuple
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cbor_canonical import (
+    encode_chunk_payload,
     encode_hash_payload,
 )
 
@@ -42,11 +43,21 @@ _MASK64 = 0xFFFFFFFFFFFFFFFF
 DEFAULT_BLOCK_SIZE = 16
 
 
-def fnv1a_64(data: bytes) -> int:
-    """64-bit FNV-1a over ``data``."""
+def fnv1a_64(data) -> int:
+    """64-bit FNV-1a over ``data`` (bytes-like).
+
+    ``bytes`` and ``bytearray`` iterate as ints natively, so the hash
+    hot loop's working ``bytearray`` passes straight through; anything
+    else (e.g. ``memoryview``) is copied to ``bytes`` first — iterating
+    a view costs more than the copy it avoids.
+    """
+    if type(data) not in (bytes, bytearray):
+        data = bytes(data)
     h = _FNV64_OFFSET
+    prime = _FNV64_PRIME
+    mask = _MASK64
     for byte in data:
-        h = ((h ^ byte) * _FNV64_PRIME) & _MASK64
+        h = ((h ^ byte) * prime) & mask
     return h
 
 
@@ -106,10 +117,24 @@ class ChunkedTokenDatabase:
     def block_size(self) -> int:
         return self.config.block_size
 
+    @property
+    def key_space(self) -> Tuple[int, int]:
+        """Identity of this processor's hash space: two chains agree on
+        every block key iff their ``(seed hash, block size)`` pairs (and
+        the model name, carried separately) agree.  Memoization caches
+        (the prefix store's block-key records) key on this so a config
+        change can never replay keys from a different space."""
+        return (self._init_hash, self.config.block_size)
+
     def chunk_hash(
         self, parent: int, tokens: Sequence[int] | None, extra=None
     ) -> int:
         """One link of the chain: FNV-64a over the canonical CBOR payload."""
+        if extra is None and tokens is not None:
+            # The per-chunk shape [parent, tokens, null]: precomputed
+            # framing, no bytes() copy (parity pinned against the
+            # generic encoder by the golden-chain tests).
+            return fnv1a_64(encode_chunk_payload(parent, tokens))
         return fnv1a_64(encode_hash_payload(parent, tokens, extra))
 
     def model_init_hash(self, model_name: str) -> int:
@@ -146,6 +171,24 @@ class ChunkedTokenDatabase:
             prefix = self.chunk_hash(prefix, chunk, None)
             keys.append(prefix)
         return keys
+
+    def extend_block_keys(
+        self, parent_key: int, tokens: Sequence[int], model_name: str
+    ) -> List[int]:
+        """Resume a block-key chain off ``parent_key``.
+
+        The memoization fast lane's suffix path: block keys are pure
+        functions of ``(seed, model, block size, token chain)``, so a
+        multi-turn conversation whose prefix keys are already known
+        only hashes its new suffix — ``tokens`` must start at the first
+        token NOT covered by a full block of the parent chain (i.e. at
+        offset ``len(prefix_keys) * block_size`` of the full token
+        list).  ``parent_key == EMPTY_BLOCK_HASH`` starts a fresh chain
+        (identical to :meth:`tokens_to_kv_block_keys`); resumed chains
+        are bit-identical to fresh full-chain hashing (pinned by the
+        property tests in tests/test_read_path_fastlane.py).
+        """
+        return self.tokens_to_kv_block_keys(parent_key, tokens, model_name)
 
 
 def engine_hash_to_uint64(raw) -> int:

@@ -47,16 +47,73 @@ class ScorerConfig:
     tier_configs: List[TierConfig] = field(default_factory=default_tier_configs)
 
 
+class ScoreChain:
+    """Resumable longest-prefix scoring state (the fast lane's chunked
+    drive): ``scores`` accumulates per-pod totals, ``active`` is the
+    set of pods still alive on every consecutive block so far (``None``
+    until block 0 has been fed)."""
+
+    __slots__ = ("scores", "active")
+
+    def __init__(self) -> None:
+        self.scores: Dict[str, float] = {}
+        self.active = None  # type: ignore[assignment]
+
+    @property
+    def alive(self) -> bool:
+        """True while feeding more blocks could still change scores."""
+        return self.active is None or bool(self.active)
+
+
 class LongestPrefixScorer:
     def __init__(self, tier_weights: Mapping[str, float]) -> None:
         self.tier_weights = dict(tier_weights)
+        # Per-snapshot weight resolution, keyed on entry-tuple IDENTITY
+        # (the in-memory index hands out one cached snapshot tuple per
+        # pod cache until it mutates, so steady-state requests re-see
+        # the same objects).  Entries hold a strong ref to the keyed
+        # object and validate with ``is`` before use, so id() reuse
+        # after GC can never alias.  Bounded by wholesale clear; benign
+        # under concurrent readers (single-key dict ops only).
+        self._resolve_cache: Dict[int, tuple] = {}
+
+    _RESOLVE_CACHE_MAX = 8192
+
+    def _resolve(self, pods: Sequence[PodEntry]) -> Dict[str, float]:
+        """{pod: max tier weight} over one block's entries, memoized
+        per snapshot identity.  Only TUPLES are cached: the in-memory
+        index hands out stable snapshot tuples that recur across
+        requests, while dict-adapted backends produce fresh lists per
+        request — caching those would churn the table (and pin dead
+        lists) for zero hits."""
+        is_tuple = type(pods) is tuple
+        if is_tuple:
+            cached = self._resolve_cache.get(id(pods))
+            if cached is not None and cached[0] is pods:
+                return cached[1]
+        weights = self.tier_weights
+        best: Dict[str, float] = {}
+        for entry in pods:
+            pod = entry.pod_identifier
+            weight = weights.get(entry.device_tier, 1.0)
+            prev = best.get(pod)
+            if prev is None or weight > prev:
+                best[pod] = weight
+        if is_tuple:
+            cache = self._resolve_cache
+            if len(cache) >= self._RESOLVE_CACHE_MAX:
+                cache.clear()
+            cache[id(pods)] = (pods, best)
+        return best
 
     def _best_entry(
         self, entries: Sequence[PodEntry], pod_id: str
     ) -> tuple:
         """(max weight, its tier) for one pod's entries on one block.
-        Single source of tier-weight resolution: ``score`` and
-        ``explain`` both resolve through here, so they cannot drift."""
+        ``explain`` resolves tiers through here; ``score``/``advance``
+        inline the same ``tier_weights.get(tier, 1.0)`` resolution on
+        the hot loop — the explain≡score property test pins the two
+        against drifting."""
         best, tier = 0.0, None
         for entry in entries:
             if entry.pod_identifier != pod_id:
@@ -66,8 +123,68 @@ class LongestPrefixScorer:
                 best, tier = weight, entry.device_tier
         return best, tier
 
-    def _max_weight(self, entries: Sequence[PodEntry], pod_id: str) -> float:
-        return self._best_entry(entries, pod_id)[0]
+    def begin(self) -> ScoreChain:
+        return ScoreChain()
+
+    def advance(
+        self,
+        chain: ScoreChain,
+        pods_per_key: Sequence[Sequence[PodEntry]],
+        pod_identifier_set=None,
+    ) -> bool:
+        """Feed the next consecutive blocks' pod entries into ``chain``.
+
+        ``pods_per_key[i]`` holds the entries for the chain's next
+        block ``i`` (in order).  Entries outside ``pod_identifier_set``
+        (when given) are ignored without allocating filtered copies.
+        Returns False once the prefix chain is dead for every candidate
+        pod — the caller can stop hashing and looking up further
+        blocks; feeding more after that is a no-op.
+        """
+        scores = chain.scores
+        active = chain.active
+        resolve = self._resolve
+        start = 0
+        if active is None:
+            if not pods_per_key:
+                return True
+            # Block 0 defines the candidate set.  The pod filter only
+            # needs applying here: later blocks intersect with
+            # ``active``, which is already a subset of the filter.
+            pods = pods_per_key[0]
+            best = resolve(pods) if pods else {}
+            if pod_identifier_set is not None and best:
+                best = {
+                    pod: weight
+                    for pod, weight in best.items()
+                    if pod in pod_identifier_set
+                }
+            scores.update(best)
+            chain.active = active = set(best)
+            if not active:
+                return False
+            start = 1
+        elif not active:
+            return False
+        for index in range(start, len(pods_per_key)):
+            pods = pods_per_key[index]
+            if not pods:
+                active.clear()
+                return False
+            best = resolve(pods)
+            best_keys = best.keys()
+            if best_keys == active:
+                # Steady state: every active pod present — accrue.
+                for pod, weight in best.items():
+                    scores[pod] += weight
+                continue
+            survivors = active & best_keys
+            chain.active = active = survivors
+            if not survivors:
+                return False
+            for pod in survivors:
+                scores[pod] += best[pod]
+        return True
 
     def score(
         self,
@@ -76,21 +193,11 @@ class LongestPrefixScorer:
     ) -> Dict[str, float]:
         if not keys:
             return {}
-
-        first_pods = key_to_pods.get(keys[0], ())
-        active = {p.pod_identifier for p in first_pods}
-        scores: Dict[str, float] = {
-            pod: self._max_weight(first_pods, pod) for pod in active
-        }
-
-        for key in keys[1:]:
-            if not active:
-                break
-            pods = key_to_pods.get(key, ())
-            active &= {p.pod_identifier for p in pods}
-            for pod in active:
-                scores[pod] += self._max_weight(pods, pod)
-        return scores
+        chain = self.begin()
+        self.advance(
+            chain, [key_to_pods.get(key, ()) for key in keys]
+        )
+        return chain.scores
 
     def explain(
         self,

@@ -96,6 +96,14 @@ class KVCacheMetrics:
             ("reason",),
             registry=self.registry,
         )
+        self.kvevents_batch_size = Histogram(
+            f"{_NAMESPACE}_kvevents_batch_size",
+            "Messages drained per kvevents worker wake-up (the batched "
+            "apply path; 1 = no batching headroom, the queue never "
+            "backed up).",
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+        )
         self.kvevents_seq_gaps = Counter(
             f"{_NAMESPACE}_kvevents_seq_gaps_total",
             "Events lost to publisher sequence-number gaps, by pod.",

@@ -20,8 +20,8 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from dataclasses import dataclass
-from typing import List, Optional
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
 
 from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
 from llm_d_kv_cache_manager_tpu.obs.trace import (
@@ -57,12 +57,28 @@ class TokenizationPoolConfig:
 
 
 @dataclass
+class TokenizedPrompt:
+    """One resolved tokenization: the token stream, the final prompt
+    text it came from (chat-rendered when a template applied), and —
+    when the prefix store carried a block-key memoization record — the
+    already-chained block keys for the first ``len(memo_keys)`` full
+    blocks of ``tokens`` (see docs/performance.md)."""
+
+    tokens: List[int]
+    text: str
+    memo_keys: Tuple[int, ...] = field(default=())
+
+
+@dataclass
 class _Task:
     prompt: str
     model_name: str
     render_req: Optional[ApplyChatTemplateRequest]
-    future: Optional["Future[List[int]]"]
+    future: Optional["Future[TokenizedPrompt]"]
     attempts: int = 0
+    # Token-processor hash-space identity for block-key memoization;
+    # None skips the memo read on the worker-side store probe.
+    key_space: Optional[tuple] = None
     # True when the submitting thread already probed the prefix store
     # for this exact prompt and missed: the worker skips its own probe
     # (one store read per miss, not two).  Chat-rendered and
@@ -133,7 +149,20 @@ class TokenizationPool:
         render_req: Optional[ApplyChatTemplateRequest] = None,
         timeout: Optional[float] = 60.0,
     ) -> List[int]:
-        """Synchronous tokenization through the pool.
+        """Synchronous tokenization through the pool (tokens only)."""
+        return self.tokenize_with_keys(
+            prompt, model_name, render_req, None, timeout
+        ).tokens
+
+    def tokenize_with_keys(
+        self,
+        prompt: str,
+        model_name: Optional[str] = None,
+        render_req: Optional[ApplyChatTemplateRequest] = None,
+        key_space: Optional[tuple] = None,
+        timeout: Optional[float] = 60.0,
+    ) -> TokenizedPrompt:
+        """Synchronous tokenization, with block-key memoization.
 
         Plain prompts probe the prefix store in the CALLING thread
         first: a steady-state scoring request whose stream is cached
@@ -146,43 +175,52 @@ class TokenizationPool:
         task sat queued, but trading that sliver of extra coverage for
         one probe per miss is the right call on the hot path).
         Chat-rendered prompts must render first and stay on the
-        queue."""
+        queue.  ``key_space`` (the token processor's hash-space
+        identity) opts the probe into returning the prefix's
+        already-chained block keys alongside the tokens (the read-path
+        fast lane; see docs/performance.md)."""
         probed = False
         if render_req is None:
             served = self._try_prefix_fast_path(
-                prompt, model_name or self.config.model_name
+                prompt, model_name or self.config.model_name, key_space
             )
             if served is not None:
                 return served
             probed = True
-        future: "Future[List[int]]" = Future()
+        future: "Future[TokenizedPrompt]" = Future()
         self._submit(
-            prompt, model_name, render_req, future, store_probed=probed
+            prompt,
+            model_name,
+            render_req,
+            future,
+            store_probed=probed,
+            key_space=key_space,
         )
         return future.result(timeout=timeout)
 
     def _try_prefix_fast_path(
-        self, prompt: str, model_name: str
-    ) -> Optional[List[int]]:
+        self,
+        prompt: str,
+        model_name: str,
+        key_space: Optional[tuple] = None,
+    ) -> Optional[TokenizedPrompt]:
         """The cached token stream when store coverage clears the
         fast-path threshold; None otherwise.  Shared by the sync
         caller path and the worker (_process)."""
         with obs_span("tokenize.prefix_probe", parent="tokenize") as s:
-            tokens, overlap_ratio = (
-                self._prefix_store.find_longest_contained_tokens(
-                    prompt, model_name
-                )
-            )
-            s.set_attr("coverage", round(overlap_ratio, 4))
-        if overlap_ratio >= self.config.min_prefix_overlap_ratio:
+            probe = self._prefix_store.probe(prompt, model_name, key_space)
+            s.set_attr("coverage", round(probe.coverage, 4))
+        if probe.coverage >= self.config.min_prefix_overlap_ratio:
             METRICS.tokenization_prefix_fast_path.inc()
             trace(
                 logger,
-                "prefix-store fast path: %d tokens at %.2f coverage",
-                len(tokens),
-                overlap_ratio,
+                "prefix-store fast path: %d tokens at %.2f coverage "
+                "(%d memoized blocks)",
+                len(probe.tokens),
+                probe.coverage,
+                probe.blocks,
             )
-            return tokens
+            return TokenizedPrompt(probe.tokens, prompt, probe.keys)
         return None
 
     def enqueue_tokenization(
@@ -195,7 +233,13 @@ class TokenizationPool:
         self._submit(prompt, model_name, render_req, None)
 
     def _submit(
-        self, prompt, model_name, render_req, future, store_probed=False
+        self,
+        prompt,
+        model_name,
+        render_req,
+        future,
+        store_probed=False,
+        key_space=None,
     ) -> None:
         self.start()
         # Waiting callers (future set) carry their trace to the worker;
@@ -208,6 +252,7 @@ class TokenizationPool:
                 render_req=render_req,
                 future=future,
                 store_probed=store_probed,
+                key_space=key_space,
                 trace=task_trace,
                 submitted_at=(
                     time.perf_counter() if task_trace is not None else 0.0
@@ -238,7 +283,7 @@ class TokenizationPool:
         # forever pending.
         while True:
             try:
-                tokens = self._process(task)
+                result = self._process(task)
             except Exception as exc:  # noqa: BLE001 — retried below
                 task.attempts += 1
                 if task.attempts < self.config.max_retries:
@@ -258,16 +303,16 @@ class TokenizationPool:
                     task.future.set_exception(exc)
                 return
             if task.future is not None:
-                task.future.set_result(tokens)
+                task.future.set_result(result)
             return
 
-    def _process(self, task: _Task) -> List[int]:
+    def _process(self, task: _Task) -> TokenizedPrompt:
         # Re-enter the submitter's trace on this worker thread so stage
         # spans (template, probe, encode) attach to the request.
         with use_trace(task.trace):
             return self._process_in_context(task)
 
-    def _process_in_context(self, task: _Task) -> List[int]:
+    def _process_in_context(self, task: _Task) -> TokenizedPrompt:
         prompt = task.prompt
         # vLLM adds special tokens to raw completion prompts but not to
         # chat-rendered ones (the template already placed them).
@@ -281,7 +326,9 @@ class TokenizationPool:
             add_special_tokens = False
 
         if not task.store_probed:
-            served = self._try_prefix_fast_path(prompt, task.model_name)
+            served = self._try_prefix_fast_path(
+                prompt, task.model_name, task.key_space
+            )
             if served is not None:
                 return served
 
@@ -293,4 +340,4 @@ class TokenizationPool:
         self._prefix_store.add_tokenization(
             prompt, encoding.tokens, encoding.offsets, task.model_name
         )
-        return encoding.tokens
+        return TokenizedPrompt(encoding.tokens, prompt)

@@ -9,7 +9,11 @@ at that node, plus a reference to a token sequence passing through it.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.lru_store import (
+    ProbeResult,
+)
 
 
 class _Node:
@@ -79,3 +83,28 @@ class TrieTokenStore:
         coverage = depth / len(data) if data else 0.0
         tokens_ref, count = best
         return list(tokens_ref[:count]), coverage
+
+    def probe(
+        self,
+        prompt: str,
+        model_name: str = "",
+        key_space: Optional[tuple] = None,
+    ) -> ProbeResult:
+        """Interface parity with ``LRUTokenStore.probe``; the trie does
+        not memoize block keys, so the record is always empty."""
+        tokens, coverage = self.find_longest_contained_tokens(
+            prompt, model_name
+        )
+        return ProbeResult(tokens, coverage, (), 0)
+
+    def attach_block_keys(
+        self,
+        prompt: str,
+        model_name: str,
+        key_space: tuple,
+        block_keys: Sequence[int],
+        tokens: Sequence[int],
+        min_blocks: int = 0,
+    ) -> int:
+        """No-op (no block-key memoization in the trie store)."""
+        return 0

@@ -113,6 +113,52 @@ class LRUCache(Generic[K, V]):
         if evicted is not None and self._on_evict is not None:
             self._on_evict(*evicted)
 
+    def put_many(self, items: Sequence[Tuple[K, V]]) -> None:
+        """Batched ``put``: ONE lock acquisition for the whole item
+        list (the kvevents write path inserts a key pair per block —
+        a 100-block store event paid 100 lock round-trips)."""
+        evicted: List[Tuple[K, V]] = []
+        with self._lock:
+            data = self._data
+            capacity = self._capacity
+            for key, value in items:
+                if key in data:
+                    data.move_to_end(key)
+                data[key] = value
+                if len(data) > capacity:
+                    evicted.append(data.popitem(last=False))
+        if evicted and self._on_evict is not None:
+            for key, value in evicted:
+                self._on_evict(key, value)
+
+    def get_or_create_many(
+        self, keys: Sequence[K], factory: Callable[[], V]
+    ) -> List[V]:
+        """Batched ``get``-or-``put_if_absent``: one lock round-trip
+        returns the resident (or freshly created) value per key, with
+        recency refreshed — the grouped-per-shard admission primitive
+        of the kvevents batched apply path.  ``factory`` runs under
+        the lock, so it must be cheap and side-effect-free."""
+        out: List[V] = []
+        evicted: List[Tuple[K, V]] = []
+        with self._lock:
+            data = self._data
+            capacity = self._capacity
+            for key in keys:
+                resident = data.get(key, _MISSING)
+                if resident is _MISSING:
+                    resident = factory()
+                    data[key] = resident
+                    if len(data) > capacity:
+                        evicted.append(data.popitem(last=False))
+                else:
+                    data.move_to_end(key)
+                out.append(resident)  # type: ignore[arg-type]
+        if evicted and self._on_evict is not None:
+            for key, value in evicted:
+                self._on_evict(key, value)
+        return out
+
     def put_if_absent(self, key: K, value: V) -> V:
         """Insert ``value`` unless ``key`` exists; return the resident value.
 

@@ -176,18 +176,35 @@ class TestInMemorySpecifics:
         assert set(resident) == set(pods[3:])
 
     def test_key_lru_eviction(self):
-        index = InMemoryIndex(InMemoryIndexConfig(size=2))
+        # shards=1 pins the exact single-LRU capacity semantics this
+        # test asserts; the sharded default bounds capacity per shard
+        # (see InMemoryIndexConfig.shards).
+        index = InMemoryIndex(InMemoryIndexConfig(size=2, shards=1))
         index.add([1, 2, 3], [11, 12, 13], [POD1])
         # Capacity 2: the oldest request key fell out.
         assert index.lookup([11, 12, 13]) == {12: [POD1], 13: [POD1]}
+
+    def test_per_shard_lru_eviction(self):
+        """Sharded capacity: eviction is LRU within each stripe, so
+        keys landing on distinct shards never evict each other."""
+        index = InMemoryIndex(InMemoryIndexConfig(size=4, shards=4))
+        # Keys 0..3 hit four distinct shards (key & 3); per-shard
+        # capacity is 1, so a same-shard key (4 -> shard 0) evicts key
+        # 0 while the other shards keep theirs.
+        index.add([0, 1, 2, 3], [0, 1, 2, 3], [POD1])
+        index.add([4], [4], [POD1])
+        assert index.lookup([4]) == {4: [POD1]}
+        found = index.lookup([1, 2, 3])
+        assert found == {1: [POD1], 2: [POD1], 3: [POD1]}
+        assert index.lookup([0]) == {}
 
     def test_empty_podcache_stops_scan(self):
         """A present-but-empty key must cut the lookup early."""
         index = InMemoryIndex(InMemoryIndexConfig(size=100))
         index.add([1, 2], [21, 22], [POD1])
         index.add([3], [23], [POD1])
-        # Manually drain key 22's pods without removing the key.
-        index._data.get(22).entries.remove(POD1)
+        # Drain key 22's pods without removing the key.
+        index._shard(22).get(22).remove_all([POD1])
         found = index.lookup([21, 22, 23])
         assert found == {21: [POD1]}
 
@@ -197,8 +214,8 @@ class TestInMemorySpecifics:
         touch_many for the keys that yielded pods); a looked-up key
         must end as recency-fresh as a per-key get would have left it
         — the next insert evicts an UNTOUCHED key, not the looked-up
-        one."""
-        index = InMemoryIndex(InMemoryIndexConfig(size=2))
+        one.  shards=1: the assertion depends on exact global LRU."""
+        index = InMemoryIndex(InMemoryIndexConfig(size=2, shards=1))
         index.add([1], [11], [POD1])
         index.add([2], [12], [POD1])
         index.lookup([11])  # refreshes 11; 12 is now the LRU victim

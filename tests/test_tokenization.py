@@ -219,11 +219,9 @@ class TestTokenizationPool:
                 super().__init__(*a, **kw)
                 self.probes = 0
 
-            def find_longest_contained_tokens(self, prompt, model):
+            def probe(self, prompt, model, key_space=None):
                 self.probes += 1
-                return super().find_longest_contained_tokens(
-                    prompt, model
-                )
+                return super().probe(prompt, model, key_space)
 
         store = CountingStore(LRUStoreConfig(block_size=16))
         pool = TokenizationPool(
