@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint test unit-test e2e-test examples obs-smoke perf-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -29,10 +29,21 @@ lint:
 	$(MAKE) kvlint
 
 # Project-invariant static analysis (hack/kvlint, stdlib-only; see
-# docs/static-analysis.md): lock discipline, tracer safety, canonical
-# serialization, blocking-in-async, swallowed errors.
+# docs/static-analysis.md): per-file rules (lock discipline, tracer
+# safety, canonical serialization, blocking-in-async, swallowed
+# errors, shutdown discipline) plus the whole-program pass (lock-order
+# graph, contract-surface drift vs docs/) — one invocation, same as CI
+# and hooks/pre-commit.sh.
 kvlint:
 	$(PYTHON) -m hack.kvlint llm_d_kv_cache_manager_tpu
+
+# Dynamic half of kvlint KV006 (same invocation as CI's "Lock-order
+# watchdog smoke" step): the concurrency storms plus the watchdog unit
+# suite with KVTPU_LOCK_ORDER_DEBUG=1, so every tracked lock —
+# including ones constructed at import time — asserts the declared
+# acquisition order while the storms hammer it (docs/static-analysis.md).
+lockorder-smoke:
+	KVTPU_LOCK_ORDER_DEBUG=1 $(PYTHON) -m pytest tests/test_concurrency.py tests/test_lockorder.py -q
 
 test: unit-test
 

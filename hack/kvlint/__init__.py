@@ -1,7 +1,17 @@
 """kvlint — project-invariant static analysis (stdlib ``ast``, no deps).
 
 Generic linters can't see this project's correctness contracts; these
-rules encode them (each in its own module, docs/static-analysis.md):
+rules encode them (each in its own module, docs/static-analysis.md).
+
+The analyzer is **two-phase**: every file is parsed once, the
+*project-model* pass (model.py) builds a cross-file symbol table
+(classes, locks and their guarded-by bindings, ``with``-lock nesting,
+env-var reads, metric registrations, trace stage names, the documented
+surface parsed from docs/), then rules run — per-file rules over each
+:class:`SourceFile`, whole-program rules over the
+:class:`~hack.kvlint.model.ProjectModel`.
+
+Per-file rules:
 
 * KV001 lock discipline — ``# guarded-by:`` attributes only touched
   under their lock (kv001_locks)
@@ -13,6 +23,17 @@ rules encode them (each in its own module, docs/static-analysis.md):
   ``async def`` (kv004_async)
 * KV005 swallowed errors — no bare/broad excepts that hide failures
   in worker loops (kv005_except)
+* KV008 shutdown discipline — threads/executors/sockets a class
+  creates need a reachable close/stop/shutdown path (kv008_resources)
+
+Whole-program rules (consume the project model):
+
+* KV006 lock order — the global lock-acquisition graph must be
+  acyclic and consistent with declared
+  ``# kvlint: lock-order: A < B`` intent (kv006_lockorder)
+* KV007 contract-surface drift — env knobs, metric names, and trace
+  stage names must agree between code and
+  docs/configuration.md + docs/observability.md (kv007_contracts)
 
 CLI: ``python -m hack.kvlint [paths...]`` — exit 0 clean, 1 findings,
 2 usage/internal error.  Output: ``path:line: RULE: message``.
@@ -29,8 +50,12 @@ from hack.kvlint import (
     kv003_serialization,
     kv004_async,
     kv005_except,
+    kv006_lockorder,
+    kv007_contracts,
+    kv008_resources,
 )
 from hack.kvlint.base import Finding, SourceFile, SourceParseError
+from hack.kvlint.model import ProjectModel, build_model
 
 RULES = (
     kv001_locks,
@@ -38,8 +63,15 @@ RULES = (
     kv003_serialization,
     kv004_async,
     kv005_except,
+    kv008_resources,
 )
-RULE_IDS = tuple(rule.RULE for rule in RULES)
+PROJECT_RULES = (
+    kv006_lockorder,
+    kv007_contracts,
+)
+RULE_IDS = tuple(rule.RULE for rule in RULES) + tuple(
+    rule.RULE for rule in PROJECT_RULES
+)
 
 
 def collect_files(paths: Sequence[str]) -> List[str]:
@@ -61,13 +93,19 @@ def collect_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
+def _parse(path: str) -> SourceFile:
+    with open(path, encoding="utf-8") as handle:
+        text = handle.read()
+    return SourceFile(path, text)
+
+
 def check_file(
     path: str, rules: Optional[Sequence[str]] = None
 ) -> List[Finding]:
-    with open(path, encoding="utf-8") as handle:
-        text = handle.read()
+    """Per-file rules over one file (the whole-program rules need the
+    project model; use :func:`check_paths` for those)."""
     try:
-        source = SourceFile(path, text)
+        source = _parse(path)
     except SourceParseError as exc:
         return [Finding(path, 0, "KV000", str(exc))]
     findings: List[Finding] = []
@@ -82,7 +120,26 @@ def check_file(
 def check_paths(
     paths: Sequence[str], rules: Optional[Sequence[str]] = None
 ) -> List[Finding]:
+    """Two-phase whole-program run: parse every file once, run the
+    per-file rules, build the project model, run the project rules."""
     findings: List[Finding] = []
+    sources: List[SourceFile] = []
     for path in collect_files(paths):
-        findings.extend(check_file(path, rules))
+        try:
+            source = _parse(path)
+        except SourceParseError as exc:
+            findings.append(Finding(path, 0, "KV000", str(exc)))
+            continue
+        sources.append(source)
+        for rule in RULES:
+            if rules and rule.RULE not in rules:
+                continue
+            findings.extend(rule.check(source))
+    if any(not rules or rule.RULE in rules for rule in PROJECT_RULES):
+        model = build_model(sources, paths)
+        for rule in PROJECT_RULES:
+            if rules and rule.RULE not in rules:
+                continue
+            findings.extend(rule.check_project(model))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings

@@ -19,7 +19,7 @@ DEFAULT_PATHS = ("llm_d_kv_cache_manager_tpu",)
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m hack.kvlint",
-        description="Project-invariant static analysis (KV001-KV005).",
+        description="Project-invariant static analysis (KV001-KV008).",
     )
     parser.add_argument(
         "paths",
@@ -58,7 +58,7 @@ def main(argv=None) -> int:
     findings = check_paths(args.paths, rules)
 
     if args.write_baseline:
-        count = baseline_mod.write(args.baseline, findings)
+        count = baseline_mod.write(args.baseline, findings, rules=rules)
         print(
             f"kvlint: wrote {count} baseline entr"
             f"{'y' if count == 1 else 'ies'} to {args.baseline}",

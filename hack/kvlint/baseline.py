@@ -17,8 +17,9 @@ Workflow (docs/static-analysis.md):
 from __future__ import annotations
 
 import os
+import re
 from collections import Counter
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from hack.kvlint.base import Finding
 
@@ -68,8 +69,33 @@ def apply(
     return kept, stale
 
 
-def write(path: str, findings: Iterable[Finding]) -> int:
+def _entry_rule(key: str) -> Optional[str]:
+    """The rule id of a ``path: RULE: message`` baseline line."""
+    match = re.search(r":\s*(KV\d{3}):", key)
+    return match.group(1) if match else None
+
+
+def write(
+    path: str,
+    findings: Iterable[Finding],
+    rules: Optional[Sequence[str]] = None,
+) -> int:
+    """Rewrite the baseline from ``findings``.
+
+    A scoped run (``--rules KV005 --write-baseline``) only saw KV005
+    findings, so it may only rewrite KV005 *entries*: existing entries
+    for unselected rules are carried over verbatim, never truncated.
+    A full run (``rules is None``) replaces the whole file.
+    """
     keys = sorted(f.baseline_key() for f in findings)
+    if rules:
+        selected = set(rules)
+        carried: List[str] = []
+        for key, count in sorted(load(path).items()):
+            rule = _entry_rule(key)
+            if rule is not None and rule not in selected:
+                carried.extend([key] * count)
+        keys = sorted(keys + carried)
     with open(path, "w", encoding="utf-8") as handle:
         handle.write(
             "# kvlint baseline — grandfathered findings (justify each "

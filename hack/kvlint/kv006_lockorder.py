@@ -1,0 +1,275 @@
+"""KV006 — whole-program lock-order / deadlock analysis.
+
+Phase 2 consumer of the project model (model.py): builds the global
+lock-acquisition graph — an edge ``A -> B`` means some code path
+acquires lock ``B`` while holding lock ``A`` — from
+
+* lexically nested ``with`` blocks inside one method,
+* calls made while holding a lock, resolved through the model's call
+  resolution (same-class calls, attr-typed cross-class calls widened
+  over subclasses), propagated to a transitive may-acquire set per
+  method.
+
+Locks aggregate per *class attribute* (``LRUCache._lock`` is one node
+no matter how many instances exist), so striped structures show
+multi-instance nesting as a self-edge — the classic
+"two shards locked in opposite orders by two threads" deadlock.
+
+Reported:
+
+* **cycles** in the graph (including declared edges): potential
+  deadlocks — two threads can enter the cycle from different points;
+* **contradictions**: an observed edge ``B -> A`` where the project
+  declared ``# kvlint: lock-order: A < B``;
+* **undeclared self-edges**: the same lock class acquired while an
+  instance of it is already held, without a
+  ``# kvlint: lock-order: L ascending`` declaration promising a
+  canonical instance order.
+
+Declared intent vocabulary (comments anywhere in the tree; the runtime
+watchdog in ``utils/lockorder.py`` asserts the same declarations under
+the concurrency storm tests):
+
+    # kvlint: lock-order: Pool._lock < LRUCache._lock
+    # kvlint: lock-order: LRUCache._lock ascending
+
+Soundness gaps (deliberate, documented in docs/static-analysis.md):
+calls on receivers whose type the model cannot infer contribute no
+edges, and locks passed across objects as plain arguments are
+invisible.  The rule over-approximates where it can (subclass
+widening) and stays silent where it cannot.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from hack.kvlint.base import Finding
+from hack.kvlint.model import ClassModel, LockRef, MethodModel, ProjectModel
+
+RULE = "KV006"
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "path", "line", "via")
+
+    def __init__(
+        self, src: str, dst: str, path: str, line: int, via: str
+    ) -> None:
+        self.src = src
+        self.dst = dst
+        self.path = path
+        self.line = line
+        self.via = via
+
+
+def _may_acquire(model: ProjectModel) -> Dict[Tuple[str, str], Set[str]]:
+    """(class, method) -> lock names the method may acquire,
+    transitively through resolvable calls (fixed point)."""
+    acquire: Dict[Tuple[str, str], Set[str]] = {}
+    for cls in model.classes.values():
+        for method in cls.methods.values():
+            acquire[(cls.name, method.name)] = {
+                ref.name for ref, _ in method.acquires
+            }
+    changed = True
+    while changed:
+        changed = False
+        for cls in model.classes.values():
+            for method in cls.methods.values():
+                key = (cls.name, method.name)
+                current = acquire[key]
+                for call in method.calls:
+                    for target_cls, target in model.resolve_call(
+                        cls, call
+                    ):
+                        extra = acquire.get(
+                            (target_cls.name, target.name)
+                        )
+                        if extra and not extra.issubset(current):
+                            current |= extra
+                            changed = True
+    return acquire
+
+
+def _build_edges(model: ProjectModel) -> List[_Edge]:
+    acquire = _may_acquire(model)
+    edges: List[_Edge] = []
+    for cls in model.classes.values():
+        for method in cls.methods.values():
+            for outer, inner, line in method.nested:
+                edges.append(
+                    _Edge(
+                        outer.name,
+                        inner.name,
+                        method.path,
+                        line,
+                        f"{cls.name}.{method.name}",
+                    )
+                )
+            for call in method.calls:
+                if not call.held:
+                    continue
+                for target_cls, target in model.resolve_call(cls, call):
+                    inner_locks = acquire.get(
+                        (target_cls.name, target.name), set()
+                    )
+                    for held in call.held:
+                        for inner_name in inner_locks:
+                            edges.append(
+                                _Edge(
+                                    held.name,
+                                    inner_name,
+                                    call.path,
+                                    call.line,
+                                    f"{cls.name}.{method.name} -> "
+                                    f"{target_cls.name}.{target.name}",
+                                )
+                            )
+    return edges
+
+
+def _declared(model: ProjectModel):
+    ordered: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    ascending: Set[str] = set()
+    for decl in model.order_decls:
+        if decl.ascending:
+            ascending.add(decl.first)
+        elif decl.second:
+            ordered.setdefault(
+                (decl.first, decl.second), (decl.path, decl.line)
+            )
+    return ordered, ascending
+
+
+def _suppressed(model: ProjectModel, path: str, line: int) -> bool:
+    source = model.by_path.get(path)
+    return bool(source and source.suppressed(line, RULE))
+
+
+def _find_cycle(
+    start: str, adjacency: Dict[str, Set[str]]
+) -> Optional[List[str]]:
+    """A simple cycle through ``start``, as a node list, or None."""
+    stack: List[Tuple[str, List[str]]] = [(start, [start])]
+    seen: Set[str] = set()
+    while stack:
+        node, trail = stack.pop()
+        for nxt in sorted(adjacency.get(node, ())):
+            if nxt == start:
+                return trail
+            if nxt in seen:
+                continue
+            seen.add(nxt)
+            stack.append((nxt, trail + [nxt]))
+    return None
+
+
+def check_project(model: ProjectModel) -> List[Finding]:
+    findings: List[Finding] = []
+    edges = _build_edges(model)
+    ordered, ascending = _declared(model)
+
+    # 1. Observed edges that contradict a declaration.
+    contradicted: Set[Tuple[str, str]] = set()
+    for edge in edges:
+        decl = ordered.get((edge.dst, edge.src))
+        if decl is None or edge.src == edge.dst:
+            continue
+        if (edge.dst, edge.src) in contradicted:
+            continue
+        contradicted.add((edge.dst, edge.src))
+        if _suppressed(model, edge.path, edge.line):
+            continue
+        findings.append(
+            Finding(
+                edge.path,
+                edge.line,
+                RULE,
+                f"'{edge.dst}' is acquired while holding "
+                f"'{edge.src}' (via {edge.via}), contradicting the "
+                f"declared lock order '{edge.dst} < {edge.src}' "
+                f"({decl[0]}:{decl[1]})",
+            )
+        )
+
+    # 2. Self-edges: multi-instance acquisition of one lock class.
+    reported_self: Set[str] = set()
+    for edge in edges:
+        if edge.src != edge.dst:
+            continue
+        if edge.src in ascending or edge.src in reported_self:
+            continue
+        reported_self.add(edge.src)
+        if _suppressed(model, edge.path, edge.line):
+            continue
+        findings.append(
+            Finding(
+                edge.path,
+                edge.line,
+                RULE,
+                f"'{edge.src}' is acquired while another instance of "
+                f"it is already held (via {edge.via}); two threads "
+                "taking instances in opposite orders deadlock — "
+                "declare a canonical instance order with "
+                f"'# kvlint: lock-order: {edge.src} ascending' and "
+                "acquire in it, or restructure to avoid the nesting",
+            )
+        )
+
+    # 3. Cycles over observed + declared edges (self-edges handled
+    # above; contradicted pairs already reported).
+    adjacency: Dict[str, Set[str]] = {}
+    provenance: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for edge in edges:
+        if edge.src == edge.dst:
+            continue
+        pair = (edge.src, edge.dst)
+        if (edge.dst, edge.src) in contradicted or pair in contradicted:
+            continue
+        adjacency.setdefault(edge.src, set()).add(edge.dst)
+        provenance.setdefault(pair, (edge.path, edge.line, edge.via))
+    for (first, second), (path, line) in ordered.items():
+        adjacency.setdefault(first, set()).add(second)
+        provenance.setdefault(
+            (first, second), (path, line, "declared order")
+        )
+
+    reported_cycles: Set[frozenset] = set()
+    for node in sorted(adjacency):
+        cycle = _find_cycle(node, adjacency)
+        if cycle is None:
+            continue
+        key = frozenset(cycle)
+        if key in reported_cycles:
+            continue
+        reported_cycles.add(key)
+        # Anchor the finding at the first OBSERVED edge of the cycle
+        # (a purely declared cycle anchors at a declaration site).
+        anchor: Optional[Tuple[str, int]] = None
+        for i, src in enumerate(cycle):
+            dst = cycle[(i + 1) % len(cycle)]
+            info = provenance.get((src, dst))
+            if info is None:
+                continue
+            if info[2] != "declared order" or anchor is None:
+                anchor = (info[0], info[1])
+                if info[2] != "declared order":
+                    break
+        if anchor is None:  # pragma: no cover - provenance is complete
+            continue
+        if _suppressed(model, anchor[0], anchor[1]):
+            continue
+        chain = " -> ".join(cycle + [cycle[0]])
+        findings.append(
+            Finding(
+                anchor[0],
+                anchor[1],
+                RULE,
+                f"lock-order cycle (potential deadlock): {chain}; "
+                "make every path acquire these locks in one global "
+                "order and declare it with "
+                "'# kvlint: lock-order: A < B'",
+            )
+        )
+    return findings

@@ -1,0 +1,188 @@
+"""KV007 — contract-surface drift between code and docs.
+
+The operator-facing surface — env knobs, Prometheus metric names, the
+trace stage vocabulary — is a contract: a knob that exists in code but
+not in docs/configuration.md is unusable, a documented knob that no
+code reads is a lie, a metric registered twice crashes the collector
+registry at import, and a stage name outside the documented
+``kvtpu_stage_latency_seconds{stage=...}`` vocabulary splinters the
+dashboard/flight-recorder correlation PR 3 built.
+
+Checks (all consume the project model; doc-dependent ones are skipped
+when no ``docs/configuration.md`` is found above the analyzed paths):
+
+* env var read in code but documented nowhere (exemptions: the
+  Kubernetes service-account environment, which the platform owns);
+* documented knob that nothing reads — code in the analyzed set, the
+  native C++ sources, or repo-root scripts (**whole-program runs
+  only**: a subtree run can't see the readers elsewhere);
+* metric name registered more than once;
+* metric registered but missing from the docs/observability.md
+  inventory (``*`` wildcard rows cover families);
+* documented metric that is never registered (whole-program only);
+* span/stage name used in code but absent from docs/observability.md.
+
+Suppression: ``# kvlint: disable=KV007`` on the flagged code line.
+Doc-side findings anchor in the markdown file and cannot be
+suppressed — fix the doc.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from hack.kvlint.base import Finding
+from hack.kvlint.model import ProjectModel
+
+RULE = "KV007"
+
+# The platform owns these; they are not project configuration surface.
+EXEMPT_ENV = {
+    "KUBERNETES_SERVICE_HOST",
+    "KUBERNETES_SERVICE_PORT",
+}
+
+# Metric names carry this namespace prefix in code; the docs inventory
+# omits it (docs/observability.md "Metrics inventory").
+METRIC_NAMESPACE = "kvtpu_"
+
+
+def _suppressed(model: ProjectModel, path: str, line: int) -> bool:
+    source = model.by_path.get(path)
+    return bool(source and source.suppressed(line, RULE))
+
+
+def check_project(model: ProjectModel) -> List[Finding]:
+    findings: List[Finding] = []
+    docs = model.docs
+
+    # -- metric uniqueness (needs no docs) ------------------------------
+    seen: Dict[str, Tuple[str, int]] = {}
+    for reg in model.metric_registrations:
+        prior = seen.get(reg.name)
+        if prior is None:
+            seen[reg.name] = (reg.path, reg.line)
+            continue
+        if _suppressed(model, reg.path, reg.line):
+            continue
+        findings.append(
+            Finding(
+                reg.path,
+                reg.line,
+                RULE,
+                f"metric '{reg.name}' is registered more than once "
+                f"(first at {prior[0]}:{prior[1]}); a duplicate "
+                "registration raises at import on a shared registry",
+            )
+        )
+
+    if docs is None:
+        return findings
+
+    # -- env knobs ------------------------------------------------------
+    reported_env: Set[Tuple[str, str]] = set()
+    for read in model.env_reads:
+        if read.name in EXEMPT_ENV or read.name in docs.knobs:
+            continue
+        key = (read.path, read.name)
+        if key in reported_env:
+            continue
+        reported_env.add(key)
+        if _suppressed(model, read.path, read.line):
+            continue
+        findings.append(
+            Finding(
+                read.path,
+                read.line,
+                RULE,
+                f"env knob '{read.name}' is read here but not "
+                "documented in docs/configuration.md (add a table "
+                "row, or '# kvlint: disable=KV007' for a deliberately "
+                "internal switch)",
+            )
+        )
+
+    if model.whole_program:
+        read_names = {r.name for r in model.env_reads}
+        read_names |= docs.external_env_reads
+        for knob, (doc_path, doc_line) in sorted(docs.knobs.items()):
+            if knob in read_names:
+                continue
+            findings.append(
+                Finding(
+                    doc_path,
+                    doc_line,
+                    RULE,
+                    f"documented env knob '{knob}' is read nowhere "
+                    "(package code, native sources, or repo scripts) "
+                    "— stale docs or a knob that silently stopped "
+                    "working",
+                )
+            )
+
+    # -- metrics vs inventory -------------------------------------------
+    registered_short: Set[str] = set()
+    for reg in model.metric_registrations:
+        short = reg.name
+        if short.startswith(METRIC_NAMESPACE):
+            short = short[len(METRIC_NAMESPACE):]
+        registered_short.add(short)
+        if reg.kind == "Counter":
+            # prometheus_client appends `_total` at exposition; the
+            # docs inventory may show either form.
+            registered_short.add(short + "_total")
+        if short in docs.metrics:
+            continue
+        if reg.kind == "Counter" and short + "_total" in docs.metrics:
+            continue
+        if any(short.startswith(w) for w in docs.metric_wildcards):
+            continue
+        if _suppressed(model, reg.path, reg.line):
+            continue
+        findings.append(
+            Finding(
+                reg.path,
+                reg.line,
+                RULE,
+                f"metric '{reg.name}' is not documented in the "
+                "docs/observability.md metrics inventory",
+            )
+        )
+    if model.whole_program:
+        for short, (doc_path, doc_line) in sorted(docs.metrics.items()):
+            if short in registered_short:
+                continue
+            # Counters register without the `_total` suffix the
+            # exposition (and therefore the docs) shows.
+            if short.endswith("_total") and short[:-6] in registered_short:
+                continue
+            findings.append(
+                Finding(
+                    doc_path,
+                    doc_line,
+                    RULE,
+                    f"documented metric '{short}' is never registered "
+                    "in code",
+                )
+            )
+
+    # -- stage vocabulary -----------------------------------------------
+    reported_stages: Set[str] = set()
+    for use in model.stage_uses:
+        if use.name in docs.stages or use.name in reported_stages:
+            continue
+        reported_stages.add(use.name)
+        if _suppressed(model, use.path, use.line):
+            continue
+        findings.append(
+            Finding(
+                use.path,
+                use.line,
+                RULE,
+                f"trace stage '{use.name}' is not part of the "
+                "documented stage vocabulary (docs/observability.md); "
+                "dashboards keyed on kvtpu_stage_latency_seconds"
+                "{stage=...} won't correlate it",
+            )
+        )
+    return findings

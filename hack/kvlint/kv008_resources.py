@@ -1,0 +1,298 @@
+"""KV008 — shutdown/resource discipline.
+
+A worker thread with no reachable stop path outlives its owner and
+keeps a dead subsystem's queue draining into nothing; an unclosed ZMQ
+socket pins its context forever (``Context.term`` blocks).  This rule
+checks that every thread / executor / socket a class creates has a
+reachable ``close``/``stop``/``shutdown`` path:
+
+* a resource **stored on self** (direct assignment, a local later
+  assigned to a ``self.<attr>``, or a local appended to a
+  ``self.<list>``) requires a *closer method* — named
+  ``close``/``stop``/``shutdown``/``terminate``/``__exit__``/
+  ``__del__``, or reachable from one through same-class calls — that
+  references the attribute;
+* a resource kept as a **local** must be cleaned up in the creating
+  method itself: a ``join``/``close``/``shutdown``/``stop``/
+  ``terminate`` call *on that local* (an unrelated ``", ".join(...)``
+  exempts nothing), creation inside a ``with`` item, or — threads and
+  executors only — the stop-event pattern (the method also creates a
+  ``threading.Event`` whose wait bounds the worker loop — the
+  ``start_*`` factory shape);
+* a local that is **returned** transfers ownership to the caller and
+  is exempt (the ``_open_socket`` factory shape — the caller's
+  ``finally`` closes it; a leak there is the caller's finding).
+
+Daemon-ness is deliberately not an excuse: a daemon thread dies with
+the process, but its subsystem can be shut down and rebuilt many times
+per process (tests do), and each leaked worker keeps consuming.
+
+Suppression: ``# kvlint: disable=KV008`` on the creating line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from hack.kvlint.base import Finding, SourceFile, dotted_name
+from hack.kvlint.model import _resource_kind
+
+RULE = "KV008"
+
+CLOSER_NAMES = {
+    "close",
+    "stop",
+    "shutdown",
+    "terminate",
+    "disconnect",
+    "__exit__",
+    "__del__",
+}
+
+_CLEANUP_CALLS = {
+    "join",
+    "close",
+    "shutdown",
+    "stop",
+    "terminate",
+    "disconnect",
+}
+
+
+def check(source: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(source.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(source, node))
+    return findings
+
+
+def _check_class(
+    source: SourceFile, cls: ast.ClassDef
+) -> List[Finding]:
+    methods = {
+        item.name: item
+        for item in cls.body
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    closer_reachable = _closer_reachable(methods)
+    closed_attrs = _attrs_touched_by(
+        methods, closer_reachable
+    )
+
+    findings: List[Finding] = []
+    for name, func in methods.items():
+        if name in closer_reachable:
+            continue
+        findings.extend(
+            _check_method(source, cls, func, closed_attrs)
+        )
+    return findings
+
+
+def _closer_reachable(methods: Dict[str, ast.AST]) -> Set[str]:
+    """Closer methods plus everything they call on self, transitively."""
+    reachable = {name for name in methods if name in CLOSER_NAMES}
+    frontier = list(reachable)
+    while frontier:
+        current = frontier.pop()
+        func = methods.get(current)
+        if func is None:
+            continue
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr in methods
+                and node.func.attr not in reachable
+            ):
+                reachable.add(node.func.attr)
+                frontier.append(node.func.attr)
+    return reachable
+
+
+def _attrs_touched_by(
+    methods: Dict[str, ast.AST], names: Set[str]
+) -> Set[str]:
+    attrs: Set[str] = set()
+    for name in names:
+        func = methods.get(name)
+        if func is None:
+            continue
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                attrs.add(node.attr)
+    return attrs
+
+
+def _check_method(
+    source: SourceFile,
+    cls: ast.ClassDef,
+    func: ast.AST,
+    closed_attrs: Set[str],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    with_items: Set[int] = set()  # id() of context-managed Call nodes
+    for node in ast.walk(func):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_items.add(id(item.context_expr))
+
+    # Locals assigned from resource constructors, and where they
+    # escape to: a self-list append (`t = Thread();
+    # self._threads.append(t)`), a plain self-attr store
+    # (`sock = socket(); self._sock = sock`), or a `return` (ownership
+    # transfers to the caller — its cleanup, its finding).
+    local_resources: Dict[str, ast.Call] = {}
+    appended_to: Dict[str, str] = {}  # local name -> self attr
+    stored_as: Dict[str, str] = {}  # local name -> self attr
+    returned: Set[str] = set()
+    cleaned_locals = _cleaned_local_names(func)
+    makes_stop_event = _creates_event(func)
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            kind = _resource_kind(node.value)
+            if kind is not None:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        local_resources[target.id] = node.value
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Name
+        ):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    stored_as[node.value.id] = target.attr
+        if isinstance(node, ast.Return) and isinstance(
+            node.value, ast.Name
+        ):
+            returned.add(node.value.id)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("append", "add")
+            and isinstance(node.func.value, ast.Attribute)
+            and isinstance(node.func.value.value, ast.Name)
+            and node.func.value.value.id == "self"
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+        ):
+            appended_to[node.args[0].id] = node.func.value.attr
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.ClassDef):
+            continue
+        # self.<attr> = <resource>()
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            kind = _resource_kind(node.value)
+            if kind is None:
+                continue
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    if target.attr in closed_attrs:
+                        continue
+                    if source.suppressed(node.lineno, RULE):
+                        continue
+                    findings.append(
+                        _leak(source, node.lineno, cls, kind, target.attr)
+                    )
+
+    # Locals: returned -> the caller owns it; stored on / appended to
+    # self -> that attr needs a closer; purely local -> the method
+    # itself must clean up.
+    for local, call in local_resources.items():
+        if id(call) in with_items or local in returned:
+            continue
+        kind = _resource_kind(call)
+        attr = appended_to.get(local) or stored_as.get(local)
+        if attr is not None:
+            if attr in closed_attrs:
+                continue
+            if source.suppressed(call.lineno, RULE):
+                continue
+            findings.append(
+                _leak(source, call.lineno, cls, kind, attr)
+            )
+        else:
+            if local in cleaned_locals:
+                continue
+            if kind in ("thread", "executor") and makes_stop_event:
+                continue
+            if source.suppressed(call.lineno, RULE):
+                continue
+            findings.append(
+                Finding(
+                    source.path,
+                    call.lineno,
+                    RULE,
+                    f"{kind} created in '{cls.name}."
+                    f"{func.name}' has no reachable stop path: the "
+                    "method neither joins/closes it, manages it with "
+                    "'with', nor creates a stop Event for its loop",
+                )
+            )
+    return findings
+
+
+def _leak(
+    source: SourceFile,
+    lineno: int,
+    cls: ast.ClassDef,
+    kind: Optional[str],
+    attr: str,
+) -> Finding:
+    return Finding(
+        source.path,
+        lineno,
+        RULE,
+        f"{kind} stored on 'self.{attr}' has no reachable "
+        f"close/stop/shutdown path: no closer method of "
+        f"'{cls.name}' ({', '.join(sorted(CLOSER_NAMES))}) "
+        "references it",
+    )
+
+
+def _cleaned_local_names(func: ast.AST) -> Set[str]:
+    """Local names that receive a cleanup call (``t.join()``,
+    ``sock.close()``).  Receiver-checked on purpose: a bare "does any
+    join/close appear" test lets ``", ".join(parts)`` mask a leaked
+    thread — the same name-matching false-match class KV004
+    deliberately avoids."""
+    names: Set[str] = set()
+    for node in ast.walk(func):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CLEANUP_CALLS
+            and isinstance(node.func.value, ast.Name)
+        ):
+            names.add(node.func.value.id)
+    return names
+
+
+def _creates_event(func: ast.AST) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if callee and callee.rsplit(".", 1)[-1] == "Event":
+                return True
+    return False

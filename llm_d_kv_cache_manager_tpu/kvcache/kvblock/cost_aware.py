@@ -17,6 +17,7 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
     Index,
     PodEntry,
 )
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 
 # Fixed per-entry overheads (dict slots, key ints, bookkeeping).  These are
 # estimates in the same spirit as the reference's per-entry cost model
@@ -36,7 +37,11 @@ def _entry_cost(entry: PodEntry) -> int:
 class CostAwareMemoryIndex(Index):
     def __init__(self, config: Optional[CostAwareIndexConfig] = None) -> None:
         self.config = config or CostAwareIndexConfig()
-        self._lock = threading.Lock()
+        # Leaf of the lock hierarchy: nothing else is acquired while
+        # held (the watchdog asserts that under the storm tests).
+        self._lock = lockorder.tracked(
+            threading.Lock(), "CostAwareMemoryIndex._lock"
+        )
         # request_key -> OrderedDict[PodEntry, cost]; outer dict is LRU.
         self._data: "OrderedDict[int, OrderedDict]" = OrderedDict()  # guarded-by: _lock
         self._engine_to_request: Dict[int, int] = {}  # guarded-by: _lock

@@ -31,7 +31,23 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
     InMemoryIndexConfig,
     PodEntry,
 )
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
+
+# The global acquisition order of this backend, declared once for both
+# halves of KV006: the comments feed the static analyzer, the
+# ``lockorder`` calls arm the runtime watchdog (asserted under the
+# concurrency storms with KVTPU_LOCK_ORDER_DEBUG=1).  Shard stripes
+# are LRUCache instances acquired in ascending shard-index order by
+# every cross-shard operation below (never nested — the rank check is
+# armed in case that ever changes).  A shard lock is never held across
+# a pod-cache call, but a pod-cache lock IS held while its bounded
+# ``entries`` LRU takes its own lock (add_all/snapshot/purge), so the
+# pod-cache lock precedes LRUCache._lock globally.
+# kvlint: lock-order: LRUCache._lock ascending
+lockorder.declare_ascending("LRUCache._lock")
+# kvlint: lock-order: _PodCache.lock < LRUCache._lock
+lockorder.declare_order("_PodCache.lock", "LRUCache._lock")
 
 
 class _PodCache:
@@ -41,7 +57,9 @@ class _PodCache:
 
     def __init__(self, capacity: int) -> None:
         self.entries: LRUCache[PodEntry, None] = LRUCache(capacity)
-        self.lock = threading.Lock()
+        self.lock = lockorder.tracked(
+            threading.Lock(), "_PodCache.lock"
+        )
         # Cached immutable snapshot of the entries, rebuilt lazily after
         # each mutation.  Read WITHOUT the lock by design: a reader
         # either sees a fully-built tuple published before the last
@@ -109,7 +127,7 @@ class InMemoryIndex(Index):
         self._mask = n_shards - 1
         per_shard = max(1, -(-self.config.size // n_shards))
         self._shards: List[LRUCache[int, _PodCache]] = [
-            LRUCache(per_shard) for _ in range(n_shards)
+            LRUCache(per_shard, lock_rank=i) for i in range(n_shards)
         ]
         self._engine_to_request: LRUCache[int, int] = LRUCache(
             self.config.size
@@ -157,7 +175,13 @@ class InMemoryIndex(Index):
         if groups is None:
             groups = self._chain_groups(request_keys)
         out: List[Optional[_PodCache]] = [None] * len(request_keys)
-        for shard_index, (positions, keys) in groups.items():
+        # Ascending shard order here and in every other cross-shard
+        # walk: the locks are taken sequentially today, so this is
+        # deadlock-proofing by construction (KV006's ascending
+        # declaration above holds even if a walk ever becomes
+        # two-phase), at the cost of one tiny sort per call.
+        for shard_index in sorted(groups):
+            positions, keys = groups[shard_index]
             values = self._shards[shard_index].peek_many(keys)
             for i, value in zip(positions, values):
                 out[i] = value
@@ -220,8 +244,8 @@ class InMemoryIndex(Index):
         mask = self._mask
         for key in request_keys:
             groups.setdefault(key & mask, []).append(key)
-        for shard_index, keys in groups.items():
-            self._shards[shard_index].touch_many(keys)
+        for shard_index in sorted(groups):  # ascending shard order
+            self._shards[shard_index].touch_many(groups[shard_index])
 
     # -- read path ------------------------------------------------------
 
@@ -320,8 +344,8 @@ class InMemoryIndex(Index):
             out.append(pods)
         consumed = len(out)
         if consumed == n_keys:
-            for shard_index, (_, keys) in groups.items():
-                self._shards[shard_index].touch_many(keys)
+            for shard_index in sorted(groups):  # ascending shard order
+                self._shards[shard_index].touch_many(groups[shard_index][1])
         elif consumed:
             self._touch_keys(request_keys[:consumed])
         return out
@@ -380,7 +404,8 @@ class InMemoryIndex(Index):
                     group = groups[request_key & mask] = ([], [])
                 group[0].append(request_key)
                 group[1].append(entries)
-        for shard_index, (keys, entry_lists) in groups.items():
+        for shard_index in sorted(groups):  # ascending shard order
+            keys, entry_lists = groups[shard_index]
             caches = self._shards[shard_index].get_or_create_many(
                 keys, lambda: _PodCache(pod_cache_size)
             )

@@ -59,6 +59,7 @@ from llm_d_kv_cache_manager_tpu.kvevents.events import (
     decode_event_batch,
 )
 from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.obs.trace import (
     TRACER,
     Trace,
@@ -69,6 +70,13 @@ from llm_d_kv_cache_manager_tpu.obs.trace import (
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
 
 logger = get_logger("kvevents.pool")
+
+# Pool lifecycle sits above the index in the lock hierarchy: a worker
+# never holds the pool lock while applying into index shards, and the
+# index never calls back into the pool.  Declared so both KV006 halves
+# catch a future inversion (e.g. a drain that applies under _lock).
+# kvlint: lock-order: Pool._lock < LRUCache._lock
+lockorder.declare_order("Pool._lock", "LRUCache._lock")
 
 # TPU pods' on-chip tier; events without an explicit medium default here
 # (GPU-era fleets default to "gpu" — both score 1.0 by default).
@@ -251,7 +259,7 @@ class Pool:
         ]
         self._threads: List[threading.Thread] = []  # guarded-by: _lock
         self._started = False  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = lockorder.tracked(threading.Lock(), "Pool._lock")
 
     def start(self) -> None:
         with self._lock:

@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
+from llm_d_kv_cache_manager_tpu.utils import lockorder
+
 import zmq
 
 from llm_d_kv_cache_manager_tpu.kvevents.pool import Message
@@ -34,7 +36,11 @@ class SubscriberManager:
         self._sink = sink
         self._context = context
         self._bind = bind
-        self._lock = threading.Lock()
+        # Subscriber stop()/join() happens OUTSIDE this lock (a wedged
+        # close must not stall reconciliation), so it stays a leaf.
+        self._lock = lockorder.tracked(
+            threading.Lock(), "SubscriberManager._lock"
+        )
         self._subscribers: Dict[str, ZMQSubscriber] = {}  # guarded-by: _lock
 
     def ensure_subscriber(

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from llm_d_kv_cache_manager_tpu.native import get_library
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("native.engine")
@@ -77,7 +78,9 @@ class _PythonEngine:
         self._executor = ThreadPoolExecutor(
             max_workers=n_threads, thread_name_prefix="kvtpu-offload"
         )
-        self._lock = threading.Lock()
+        self._lock = lockorder.tracked(
+            threading.Lock(), "_PythonEngine._lock"
+        )
         self._jobs: Dict[int, List[Future]] = {}
 
     @staticmethod
@@ -173,7 +176,9 @@ class OffloadEngine:
         self._lib = get_library()
         self._closed = False
         self.n_threads = n_threads
-        self._buffers_lock = threading.Lock()
+        self._buffers_lock = lockorder.tracked(
+            threading.Lock(), "OffloadEngine._buffers_lock"
+        )
         # Keep buffer references alive until their job is harvested.
         self._live_buffers: Dict[int, list] = {}
         if self._lib is not None:

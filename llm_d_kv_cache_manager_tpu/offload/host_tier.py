@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("offload.host_tier")
@@ -44,7 +45,11 @@ class HostTierCache:
         self._on_evict = on_evict
         self._entries: "OrderedDict[int, np.ndarray]" = OrderedDict()  # guarded-by: _lock
         self._bytes = 0  # guarded-by: _lock
-        self._lock = threading.Lock()
+        # Leaf lock: on_evict deliberately fires OUTSIDE it, so no
+        # other lock is ever acquired while this one is held.
+        self._lock = lockorder.tracked(
+            threading.Lock(), "HostTierCache._lock"
+        )
         self.hits = 0  # guarded-by: _lock
         self.misses = 0  # guarded-by: _lock
 

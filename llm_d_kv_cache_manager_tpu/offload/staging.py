@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Optional
 
+from llm_d_kv_cache_manager_tpu.utils import lockorder
+
 
 class StagingBudget:
     """Byte-budget gate for in-flight host buffers.
@@ -29,7 +31,11 @@ class StagingBudget:
             raise ValueError(f"max_bytes must be positive, got {max_bytes}")
         self.max_bytes = max_bytes
         self._in_flight = 0  # guarded-by: _cond
-        self._cond = threading.Condition()
+        # Leaf of the offload lock hierarchy: waiters block here, but
+        # nothing else is acquired while it is held.
+        self._cond = lockorder.tracked(
+            threading.Condition(), "StagingBudget._cond"
+        )
 
     @property
     def in_flight_bytes(self) -> int:

@@ -52,7 +52,18 @@ from llm_d_kv_cache_manager_tpu.offload.manager import (
     SharedStorageOffloadManager,
 )
 from llm_d_kv_cache_manager_tpu.offload.staging import StagingBudget
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+# drain()/wait_for() hold the router lock across engine.get_finished(),
+# whose fallback/buffer locks nest inside — the one cross-component
+# nesting in the offload path, declared for both KV006 halves.
+# kvlint: lock-order: CompletionRouter._lock < _PythonEngine._lock
+lockorder.declare_order("CompletionRouter._lock", "_PythonEngine._lock")
+# kvlint: lock-order: CompletionRouter._lock < OffloadEngine._buffers_lock
+lockorder.declare_order(
+    "CompletionRouter._lock", "OffloadEngine._buffers_lock"
+)
 
 logger = get_logger("offload.vllm_spec")
 
@@ -331,7 +342,9 @@ class CompletionRouter:
     def __init__(self, engine: OffloadEngine) -> None:
         self.engine = engine
         self._unclaimed: Dict[int, JobStatus] = {}  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = lockorder.tracked(
+            threading.Lock(), "CompletionRouter._lock"
+        )
 
     def drain(self, owned_ids) -> List[Tuple[int, JobStatus]]:
         """Harvest engine completions; return only those in ``owned_ids``."""

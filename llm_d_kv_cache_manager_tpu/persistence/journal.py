@@ -56,6 +56,7 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cbor_canonical import (
 )
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
 from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("persistence.journal")
@@ -213,7 +214,9 @@ class Journal:
         self._segment_bytes = 0  # guarded-by: _lock
         self._watermarks: Dict[str, int] = {}  # guarded-by: _lock
         self._records_since_snapshot = 0  # guarded-by: _lock
-        self._lock = threading.Lock()
+        # Leaf lock: appends/rotations never acquire anything else
+        # while holding it (index apply happens before the journal tap).
+        self._lock = lockorder.tracked(threading.Lock(), "Journal._lock")
 
     # -- append path ---------------------------------------------------
 

@@ -47,9 +47,31 @@ from llm_d_kv_cache_manager_tpu.persistence.snapshot import (
     load_latest_snapshot,
     write_snapshot,
 )
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("persistence.recovery")
+
+# snapshot() holds _snapshot_lock across the journal boundary/compact
+# calls, the index dump, and the _info_lock publish — the snapshot lock
+# is the root of the persistence hierarchy.  Declared for KV006 and the
+# runtime watchdog alike.
+# kvlint: lock-order: PersistenceManager._snapshot_lock < Journal._lock
+lockorder.declare_order(
+    "PersistenceManager._snapshot_lock", "Journal._lock"
+)
+# kvlint: lock-order: PersistenceManager._snapshot_lock < PersistenceManager._info_lock
+lockorder.declare_order(
+    "PersistenceManager._snapshot_lock", "PersistenceManager._info_lock"
+)
+# kvlint: lock-order: PersistenceManager._snapshot_lock < LRUCache._lock
+lockorder.declare_order(
+    "PersistenceManager._snapshot_lock", "LRUCache._lock"
+)
+# kvlint: lock-order: PersistenceManager._snapshot_lock < CostAwareMemoryIndex._lock
+lockorder.declare_order(
+    "PersistenceManager._snapshot_lock", "CostAwareMemoryIndex._lock"
+)
 
 
 @dataclass
@@ -193,11 +215,15 @@ class PersistenceManager:
             segment_max_bytes=config.journal_segment_max_bytes,
             fsync=config.journal_fsync,
         )
-        self._snapshot_lock = threading.Lock()
+        self._snapshot_lock = lockorder.tracked(
+            threading.Lock(), "PersistenceManager._snapshot_lock"
+        )
         # Separate from _snapshot_lock (held across the whole
         # dump+fsync): /healthz reads must never block on a slow
         # snapshot publish.
-        self._info_lock = threading.Lock()
+        self._info_lock = lockorder.tracked(
+            threading.Lock(), "PersistenceManager._info_lock"
+        )
         self.last_snapshot: Optional[SnapshotInfo] = None  # guarded-by: _info_lock
 
     def recover(self, index: Index) -> RecoveryReport:

@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.obs.trace import (
     Trace,
     current_trace,
@@ -110,7 +111,11 @@ class TokenizationPool:
             self.config.queue_size
         )
         self._threads: List[threading.Thread] = []  # guarded-by: _lock
-        self._lock = threading.Lock()
+        # Lifecycle-only lock (start/shutdown); worker tokenization
+        # never runs under it, so it stays a hierarchy leaf.
+        self._lock = lockorder.tracked(
+            threading.Lock(), "TokenizationPool._lock"
+        )
         self._started = False  # guarded-by: _lock
 
     def set_tokenizer(self, tokenizer: Tokenizer, model_name: str) -> None:

@@ -1,0 +1,217 @@
+"""Runtime lock-order watchdog: the dynamic half of kvlint KV006.
+
+The static rule (hack/kvlint/kv006_lockorder.py) proves the global
+lock-acquisition graph acyclic from the source; this module asserts the
+same declared order while the code actually runs, so the two validate
+each other — a nesting the static model cannot see (a lock smuggled
+through an untyped receiver) still trips the watchdog under the
+concurrency storm tests, and a stale declaration trips it immediately.
+
+Debug-gated and ~zero-cost when off: :func:`tracked` returns the lock
+it was given unchanged unless the watchdog is enabled
+(``KVTPU_LOCK_ORDER_DEBUG=1``, or :func:`enable` from tests), so
+production lock acquisition never crosses a wrapper.
+
+Vocabulary (mirrors the ``# kvlint: lock-order:`` comment annotations
+the static rule reads — declare both at the same site):
+
+* :func:`declare_order(first, second)` — ``first < second``: any
+  thread holding ``second`` must not acquire ``first``.
+* :func:`declare_ascending(name)` — multiple instances of ``name``
+  are only ever acquired in ascending :func:`tracked` ``rank`` order
+  (the striped-shard pattern).
+
+Checks fire on acquire, against a per-thread stack of held locks:
+
+* re-acquiring the *same instance* of a non-reentrant lock
+  (guaranteed self-deadlock; RLocks/Conditions re-enter freely);
+* same-name nesting without an ``ascending`` declaration;
+* same-name nesting with one, but a rank that is missing or not
+  strictly greater than every held instance's;
+* acquiring ``first`` of a declared pair while ``second`` is held.
+
+Violations raise :class:`LockOrderViolation` (an ``AssertionError``
+subclass, so storm tests fail loudly instead of deadlocking flakily).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Set, Tuple
+
+__all__ = [
+    "LockOrderViolation",
+    "declare_ascending",
+    "declare_order",
+    "enable",
+    "enabled",
+    "held",
+    "reset_declarations",
+    "tracked",
+]
+
+
+class LockOrderViolation(AssertionError):
+    """A lock was acquired against the declared global order."""
+
+
+_enabled = os.environ.get("KVTPU_LOCK_ORDER_DEBUG", "") in (
+    "1",
+    "true",
+    "yes",
+)
+# first < second pairs and ascending-instance lock names.  Module-level
+# registries mutated only at import/declaration time (single-threaded),
+# read on every tracked acquire.
+_ordered_pairs: Set[Tuple[str, str]] = set()
+_ascending: Set[str] = set()
+
+_state = threading.local()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(flag: bool = True) -> bool:
+    """Toggle the watchdog (tests); returns the previous state.
+
+    Only locks created by :func:`tracked` *after* enabling are checked
+    — construct the structures under test after calling this.
+    """
+    global _enabled
+    previous = _enabled
+    _enabled = flag
+    return previous
+
+
+def declare_order(first: str, second: str) -> None:
+    """Declare ``first < second``: ``first`` is always acquired before
+    ``second``; holding ``second`` forbids acquiring ``first``."""
+    _ordered_pairs.add((first, second))
+
+
+def declare_ascending(name: str) -> None:
+    """Declare that instances of ``name`` nest only in ascending
+    ``rank`` order (e.g. shard stripes by shard index)."""
+    _ascending.add(name)
+
+
+def reset_declarations() -> None:
+    """Drop every declaration (test isolation)."""
+    _ordered_pairs.clear()
+    _ascending.clear()
+
+
+def held() -> List[Tuple[str, Optional[int]]]:
+    """The current thread's held tracked locks, outermost first."""
+    return [
+        (name, rank) for name, rank, _ in getattr(_state, "stack", ())
+    ]
+
+
+def _check(
+    name: str, rank: Optional[int], ident: int, reentrant: bool
+) -> None:
+    stack = getattr(_state, "stack", [])
+    if any(held_ident == ident for _, _, held_ident in stack):
+        # Re-acquiring an instance this thread already holds: an RLock
+        # (or Condition) re-enters without blocking — no hazard, no
+        # order to check; a plain Lock is a guaranteed self-deadlock.
+        if reentrant:
+            return
+        raise LockOrderViolation(
+            f"'{name}' re-acquired by the thread already holding it — "
+            "a non-reentrant lock self-deadlocks here"
+        )
+    for held_name, held_rank, _ in stack:
+        if held_name == name:
+            if name not in _ascending:
+                raise LockOrderViolation(
+                    f"'{name}' acquired while another instance of it is "
+                    "held, with no '# kvlint: lock-order: "
+                    f"{name} ascending' declaration"
+                )
+            if rank is None or held_rank is None or rank <= held_rank:
+                raise LockOrderViolation(
+                    f"'{name}' instances must be acquired in ascending "
+                    f"rank order: holding rank {held_rank!r}, acquiring "
+                    f"rank {rank!r}"
+                )
+        elif (name, held_name) in _ordered_pairs:
+            raise LockOrderViolation(
+                f"'{name}' acquired while holding '{held_name}', "
+                f"contradicting the declared order "
+                f"'{name} < {held_name}'"
+            )
+
+
+class TrackedLock:
+    """Order-asserting proxy over a ``threading`` lock primitive.
+
+    Proxies ``acquire``/``release`` and the context-manager protocol;
+    anything else (``locked``, ``notify`` for Conditions) falls through
+    via ``__getattr__``.
+    """
+
+    __slots__ = ("_lock", "_name", "_rank", "_reentrant")
+
+    def __init__(self, lock, name: str, rank: Optional[int]) -> None:
+        self._lock = lock
+        self._name = name
+        self._rank = rank
+        self._reentrant = type(lock).__name__ in ("RLock", "Condition")
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def rank(self) -> Optional[int]:
+        return self._rank
+
+    def acquire(self, *args, **kwargs):
+        _check(self._name, self._rank, id(self), self._reentrant)
+        acquired = self._lock.acquire(*args, **kwargs)
+        if acquired:
+            stack = getattr(_state, "stack", None)
+            if stack is None:
+                stack = _state.stack = []
+            stack.append((self._name, self._rank, id(self)))
+        return acquired
+
+    def release(self) -> None:
+        self._lock.release()
+        stack = getattr(_state, "stack", [])
+        # Remove the innermost matching hold (locks release LIFO in
+        # `with` blocks; out-of-order manual release still unwinds the
+        # right entry; reentrant holds pop one level per release).
+        ident = id(self)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][2] == ident:
+                del stack[i]
+                break
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.release()
+
+    def __getattr__(self, attr):
+        return getattr(self._lock, attr)
+
+
+def tracked(lock, name: str, rank: Optional[int] = None):
+    """Wrap ``lock`` for order checking — identity when the watchdog
+    is off, so the production fast path never pays for it.
+
+    ``name`` should match the static model's lock identity
+    (``Class._attr``); ``rank`` disambiguates instances under an
+    ``ascending`` declaration (e.g. the shard index).
+    """
+    if not _enabled:
+        return lock
+    return TrackedLock(lock, name, rank)

@@ -22,6 +22,8 @@ from typing import (
     TypeVar,
 )
 
+from llm_d_kv_cache_manager_tpu.utils import lockorder
+
 K = TypeVar("K", bound=Hashable)
 V = TypeVar("V")
 
@@ -40,12 +42,20 @@ class LRUCache(Generic[K, V]):
         self,
         capacity: int,
         on_evict: Optional[Callable[[K, V], None]] = None,
+        *,
+        lock_rank: Optional[int] = None,
     ) -> None:
         if capacity <= 0:
             raise ValueError(f"LRU capacity must be positive, got {capacity}")
         self._capacity = capacity
         self._data: "OrderedDict[K, V]" = OrderedDict()  # guarded-by: _lock
-        self._lock = threading.Lock()
+        # ``lock_rank`` orders instances under the lock-order watchdog
+        # (utils/lockorder.py): striped owners pass their stripe index
+        # so same-name nesting asserts ascending acquisition.  With the
+        # watchdog off, tracked() returns the bare Lock unchanged.
+        self._lock = lockorder.tracked(
+            threading.Lock(), "LRUCache._lock", lock_rank
+        )
         self._on_evict = on_evict
 
     def __len__(self) -> int:

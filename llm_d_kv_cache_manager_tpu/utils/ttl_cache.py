@@ -13,8 +13,16 @@ import threading
 import time
 from typing import Callable, Dict, Generic, Optional, TypeVar
 
+from llm_d_kv_cache_manager_tpu.utils import lockorder
+
 K = TypeVar("K")
 V = TypeVar("V")
+
+# Both static KV006 and the runtime watchdog assert this: the callback
+# serializer wraps the entry lock (set/_fire_eviction), never the
+# other way around.
+# kvlint: lock-order: TTLCache._cb_lock < TTLCache._lock
+lockorder.declare_order("TTLCache._cb_lock", "TTLCache._lock")
 
 
 class TTLCache(Generic[K, V]):
@@ -27,12 +35,16 @@ class TTLCache(Generic[K, V]):
         self._on_evict = on_evict
         # key -> (value, deadline)
         self._entries: Dict[K, tuple] = {}  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = lockorder.tracked(
+            threading.Lock(), "TTLCache._lock"
+        )
         # Serializes set() against expiry callbacks so a re-insert can
         # never interleave between the is-it-still-absent check and the
         # on_evict call (which would tear down the fresh state).  RLock
         # so an on_evict callback may itself call set().
-        self._cb_lock = threading.RLock()
+        self._cb_lock = lockorder.tracked(
+            threading.RLock(), "TTLCache._cb_lock"
+        )
         self._sweeper: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -129,3 +141,12 @@ class TTLCache(Generic[K, V]):
             self._sweeper.join(timeout=5)
             self._sweeper = None
         self._stop.clear()
+
+    def close(self) -> None:
+        """Canonical shutdown: stop the sweeper thread (idempotent).
+
+        Callers that only ever used :meth:`stop_sweeper` keep working;
+        owners tearing a subsystem down get the conventional name (and
+        KV008's reachable-closer check keys on it).
+        """
+        self.stop_sweeper()

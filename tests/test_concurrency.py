@@ -38,10 +38,27 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
     ChunkedTokenDatabase,
     TokenProcessorConfig,
 )
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.utils.ttl_cache import TTLCache
 
 THREADS = 8
 OPS = 300
+
+
+@pytest.fixture(autouse=True)
+def lock_order_watchdog():
+    """Arm the runtime lock-order watchdog for every storm in this
+    module: the structures under test are constructed inside the tests
+    (after enable), so their locks become order-asserting TrackedLocks
+    and any acquisition against the declared KV006 order raises
+    LockOrderViolation instead of deadlocking flakily.  `make
+    lockorder-smoke` runs this same suite with KVTPU_LOCK_ORDER_DEBUG=1
+    so even import-time-constructed locks are covered."""
+    previous = lockorder.enable(True)
+    try:
+        yield
+    finally:
+        lockorder.enable(previous)
 
 
 class TestIndexUnderContention:

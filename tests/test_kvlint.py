@@ -538,3 +538,919 @@ class TestBaselineWorkflow:
                 if line.strip() and not line.startswith("#")
             ]
         assert entries == []
+
+
+# ---------------------------------------------------------------------------
+# Whole-program pass (PR 5): project model + KV006/KV007/KV008
+# ---------------------------------------------------------------------------
+
+from hack.kvlint import check_paths  # noqa: E402
+from hack.kvlint.model import build_model  # noqa: E402
+from hack.kvlint import _parse  # noqa: E402
+
+# Defaults are deliberately minimal AND self-consistent (no documented
+# knob or exact metric that a fixture would then fail to read/register
+# — the whole-program drift checks cut both ways); tests that need a
+# documented surface pass their own markdown.
+CONFIG_MD = """\
+# Configuration
+
+| Env var | Default | Meaning |
+|---|---|---|
+"""
+
+KNOB_CONFIG_MD = CONFIG_MD + "| `MY_KNOB` | 1 | a documented knob |\n"
+
+OBS_MD = """\
+# Observability
+
+Spans: `tokenize`, `score`.
+
+## Metrics inventory
+
+| metric | labels | meaning |
+|---|---|---|
+| `persistence_*` | varies | a wildcard family |
+"""
+
+
+def project(tmp_path, files, config_md=CONFIG_MD, obs_md=OBS_MD):
+    """Materialize a synthetic project (docs/ + pkg/) and return the
+    package path — analyzed directly under the root, so the
+    whole-program doc checks arm exactly like the CI invocation."""
+    root = tmp_path / "proj"
+    (root / "docs").mkdir(parents=True)
+    (root / "docs" / "configuration.md").write_text(config_md)
+    (root / "docs" / "observability.md").write_text(obs_md)
+    pkg = root / "pkg"
+    pkg.mkdir()
+    for name, code in files.items():
+        path = pkg / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(code))
+    return pkg
+
+
+def lint_project(tmp_path, files, rules=None, **docs):
+    pkg = project(tmp_path, files, **docs)
+    return check_paths([str(pkg)], rules)
+
+
+class TestProjectModel:
+    """Phase 1: the cross-file symbol table the project rules consume."""
+
+    def test_env_reads_through_module_helper(self, tmp_path):
+        pkg = project(
+            tmp_path,
+            {
+                "cfg.py": """
+                    import os
+
+                    def _env_int(name, default):
+                        return int(os.environ.get(name, default))
+
+                    RING = _env_int("RING_SIZE", 256)
+                    DIRECT = os.environ["DIRECT_KNOB"]
+                    ALSO = os.getenv("GETENV_KNOB")
+                """
+            },
+        )
+        sources = [_parse(str(pkg / "cfg.py"))]
+        model = build_model(sources, [str(pkg)])
+        names = {read.name for read in model.env_reads}
+        assert {"RING_SIZE", "DIRECT_KNOB", "GETENV_KNOB"} <= names
+
+    def test_metric_name_resolution_through_fstring(self, tmp_path):
+        pkg = project(
+            tmp_path,
+            {
+                "metrics.py": """
+                    _NS = "kvtpu"
+
+                    class Counter:
+                        def __init__(self, name, doc):
+                            pass
+
+                    C = Counter(f"{_NS}_x_total", "doc")
+                """
+            },
+        )
+        sources = [_parse(str(pkg / "metrics.py"))]
+        model = build_model(sources, [str(pkg)])
+        assert [r.name for r in model.metric_registrations] == [
+            "kvtpu_x_total"
+        ]
+
+    def test_attr_typing_and_subclass_widening(self, tmp_path):
+        pkg = project(
+            tmp_path,
+            {
+                "a.py": """
+                    import threading
+
+                    class Base:
+                        pass
+
+                    class Impl(Base):
+                        def __init__(self):
+                            self._lock = threading.Lock()
+
+                        def op(self):
+                            with self._lock:
+                                pass
+
+                    class Holder:
+                        def __init__(self, backend: Base):
+                            self._backend = backend
+
+                        def go(self):
+                            self._backend.op()
+                """
+            },
+        )
+        sources = [_parse(str(pkg / "a.py"))]
+        model = build_model(sources, [str(pkg)])
+        holder = model.classes["Holder"]
+        call = holder.methods["go"].calls[0]
+        targets = {
+            cls.name for cls, _ in model.resolve_call(holder, call)
+        }
+        # An attr typed as the base resolves to the subclass that
+        # defines the method — the documented over-approximation.
+        assert targets == {"Impl"}
+
+    def test_docs_surface_parsed(self, tmp_path):
+        obs = OBS_MD + "| `x_total` | — | things |\n"
+        pkg = project(
+            tmp_path,
+            {"empty.py": ""},
+            config_md=KNOB_CONFIG_MD,
+            obs_md=obs,
+        )
+        sources = [_parse(str(pkg / "empty.py"))]
+        model = build_model(sources, [str(pkg)])
+        assert model.whole_program
+        assert "MY_KNOB" in model.docs.knobs
+        assert "x_total" in model.docs.metrics
+        assert "persistence_" in model.docs.metric_wildcards
+        assert {"tokenize", "score"} <= model.docs.stages
+
+
+CYCLE_FIXTURE = {
+    "a.py": """
+        import threading
+
+        class A:
+            def __init__(self, b: "B"):
+                self._lock = threading.Lock()
+                self._b = b
+
+            def bump(self):
+                with self._lock:
+                    pass
+
+            def kick(self):
+                with self._lock:
+                    self._b.poke()
+    """,
+    "b.py": """
+        import threading
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._a = None
+
+            def adopt(self, a: "A"):
+                self._a = a
+
+            def poke(self):
+                with self._lock:
+                    self._a.bump()
+    """,
+}
+
+
+class TestKV006LockOrder:
+    def test_planted_cycle_reported(self, tmp_path):
+        findings = lint_project(
+            tmp_path, CYCLE_FIXTURE, rules=("KV006",)
+        )
+        assert findings and set(rule_ids(findings)) == {"KV006"}
+        cycles = [f for f in findings if "cycle" in f.message]
+        assert len(cycles) == 1
+        assert "A._lock" in cycles[0].message
+        assert "B._lock" in cycles[0].message
+
+    def test_one_direction_passes(self, tmp_path):
+        files = dict(CYCLE_FIXTURE)
+        # Break the cycle: B.poke no longer calls back into A.
+        files["b.py"] = files["b.py"].replace("self._a.bump()", "pass")
+        assert lint_project(tmp_path, files, rules=("KV006",)) == []
+
+    def test_declared_order_contradiction(self, tmp_path):
+        files = {
+            "x.py": """
+                import threading
+
+                # kvlint: lock-order: X._lock < Y._lock
+
+                class X:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def bump(self):
+                        with self._lock:
+                            pass
+
+                class Y:
+                    def __init__(self, x: X):
+                        self._lock = threading.Lock()
+                        self._x = x
+
+                    def poke(self):
+                        with self._lock:
+                            self._x.bump()
+            """
+        }
+        findings = lint_project(tmp_path, files, rules=("KV006",))
+        assert rule_ids(findings) == ["KV006"]
+        assert "contradicting the declared lock order" in findings[0].message
+
+    def test_multi_instance_nesting_needs_ascending(self, tmp_path):
+        files = {
+            "shard.py": """
+                import threading
+
+                class Shard:
+                    def __init__(self, peer: "Shard"):
+                        self._lock = threading.Lock()
+                        self._peer = peer
+
+                    def grab(self):
+                        with self._lock:
+                            pass
+
+                    def cascade(self):
+                        with self._lock:
+                            self._peer.grab()
+            """
+        }
+        findings = lint_project(tmp_path, files, rules=("KV006",))
+        assert rule_ids(findings) == ["KV006"]
+        assert "another instance" in findings[0].message
+
+    def test_ascending_declaration_accepts_nesting(self, tmp_path):
+        files = {
+            "shard.py": """
+                import threading
+
+                # kvlint: lock-order: Shard._lock ascending
+
+                class Shard:
+                    def __init__(self, peer: "Shard"):
+                        self._lock = threading.Lock()
+                        self._peer = peer
+
+                    def grab(self):
+                        with self._lock:
+                            pass
+
+                    def cascade(self):
+                        with self._lock:
+                            self._peer.grab()
+            """
+        }
+        assert lint_project(tmp_path, files, rules=("KV006",)) == []
+
+    def test_lexical_nesting_consistent_passes(self, tmp_path):
+        files = {
+            "n.py": """
+                import threading
+
+                class N:
+                    def __init__(self):
+                        self._outer = threading.Lock()
+                        self._inner = threading.Lock()
+
+                    def a(self):
+                        with self._outer:
+                            with self._inner:
+                                pass
+
+                    def b(self):
+                        with self._outer:
+                            with self._inner:
+                                pass
+            """
+        }
+        assert lint_project(tmp_path, files, rules=("KV006",)) == []
+
+    def test_lexical_nesting_inverted_cycle(self, tmp_path):
+        files = {
+            "n.py": """
+                import threading
+
+                class N:
+                    def __init__(self):
+                        self._outer = threading.Lock()
+                        self._inner = threading.Lock()
+
+                    def a(self):
+                        with self._outer:
+                            with self._inner:
+                                pass
+
+                    def b(self):
+                        with self._inner:
+                            with self._outer:
+                                pass
+            """
+        }
+        findings = lint_project(tmp_path, files, rules=("KV006",))
+        assert rule_ids(findings) == ["KV006"]
+        assert "cycle" in findings[0].message
+
+    def test_multi_item_with_inverted_cycle(self, tmp_path):
+        # `with a, b:` nests left to right exactly like the nested
+        # form; an inversion written this way must still be a cycle.
+        files = {
+            "m.py": """
+                import threading
+
+                class M:
+                    def __init__(self):
+                        self._outer = threading.Lock()
+                        self._inner = threading.Lock()
+
+                    def a(self):
+                        with self._outer, self._inner:
+                            pass
+
+                    def b(self):
+                        with self._inner, self._outer:
+                            pass
+            """
+        }
+        findings = lint_project(tmp_path, files, rules=("KV006",))
+        assert rule_ids(findings) == ["KV006"]
+        assert "cycle" in findings[0].message
+
+    def test_multi_item_with_consistent_order_passes(self, tmp_path):
+        files = {
+            "m.py": """
+                import threading
+
+                class M:
+                    def __init__(self):
+                        self._outer = threading.Lock()
+                        self._inner = threading.Lock()
+
+                    def a(self):
+                        with self._outer, self._inner:
+                            pass
+
+                    def b(self):
+                        with self._outer:
+                            with self._inner:
+                                pass
+            """
+        }
+        assert lint_project(tmp_path, files, rules=("KV006",)) == []
+
+    def test_module_level_lock_cycle(self, tmp_path):
+        # Module-level functions acquire module locks by bare name;
+        # their nesting must feed the graph like any method's.
+        files = {
+            "g.py": """
+                import threading
+
+                _reg_lock = threading.Lock()
+                _build_lock = threading.Lock()
+
+                def get():
+                    with _reg_lock:
+                        with _build_lock:
+                            pass
+
+                def rebuild():
+                    with _build_lock:
+                        with _reg_lock:
+                            pass
+            """
+        }
+        findings = lint_project(tmp_path, files, rules=("KV006",))
+        assert rule_ids(findings) == ["KV006"]
+        assert "cycle" in findings[0].message
+        assert "module:" in findings[0].message
+
+    def test_same_named_module_locks_stay_distinct(self, tmp_path):
+        # Two `__init__.py` files, each with its own `_a`/`_b` pair
+        # nested in opposite directions.  Stem-derived module owners
+        # would merge them onto one node pair and invent a cycle that
+        # exists in no program; path-derived owners keep them apart.
+        files = {
+            "alpha/__init__.py": """
+                import threading
+
+                _a = threading.Lock()
+                _b = threading.Lock()
+
+                def use():
+                    with _a:
+                        with _b:
+                            pass
+            """,
+            "beta/__init__.py": """
+                import threading
+
+                _a = threading.Lock()
+                _b = threading.Lock()
+
+                def use():
+                    with _b:
+                        with _a:
+                            pass
+            """,
+        }
+        assert lint_project(tmp_path, files, rules=("KV006",)) == []
+
+
+class TestKV007ContractDrift:
+    def test_undocumented_knob_reported(self, tmp_path):
+        findings = lint_project(
+            tmp_path,
+            {
+                "cfg.py": """
+                    import os
+                    GOOD = os.environ.get("MY_KNOB")
+                    BAD = os.environ.get("SECRET_KNOB")
+                """
+            },
+            rules=("KV007",),
+            config_md=KNOB_CONFIG_MD,
+        )
+        assert [f.rule for f in findings] == ["KV007"]
+        assert "SECRET_KNOB" in findings[0].message
+        assert "MY_KNOB" not in findings[0].message
+
+    def test_doc_only_knob_reported(self, tmp_path):
+        config = KNOB_CONFIG_MD + "| `GHOST_KNOB` | — | reads nowhere |\n"
+        findings = lint_project(
+            tmp_path,
+            {
+                "cfg.py": """
+                    import os
+                    GOOD = os.environ.get("MY_KNOB")
+                """
+            },
+            rules=("KV007",),
+            config_md=config,
+        )
+        assert [f.rule for f in findings] == ["KV007"]
+        assert "GHOST_KNOB" in findings[0].message
+        assert findings[0].path.endswith("configuration.md")
+
+    def test_duplicate_metric_registration(self, tmp_path):
+        obs = OBS_MD + "| `x_total` | — | things |\n"
+        findings = lint_project(
+            tmp_path,
+            {
+                "m.py": """
+                    class Counter:
+                        def __init__(self, name, doc):
+                            pass
+
+                    A = Counter("kvtpu_x_total", "doc")
+                    B = Counter("kvtpu_x_total", "doc")
+                """
+            },
+            rules=("KV007",),
+            obs_md=obs,
+        )
+        assert [f.rule for f in findings] == ["KV007"]
+        assert "more than once" in findings[0].message
+
+    def test_undocumented_metric_reported(self, tmp_path):
+        findings = lint_project(
+            tmp_path,
+            {
+                "m.py": """
+                    class Counter:
+                        def __init__(self, name, doc):
+                            pass
+
+                    A = Counter("kvtpu_mystery_total", "doc")
+                """
+            },
+            rules=("KV007",),
+        )
+        assert [f.rule for f in findings] == ["KV007"]
+        assert "mystery_total" in findings[0].message
+
+    def test_wildcard_row_covers_family(self, tmp_path):
+        assert (
+            lint_project(
+                tmp_path,
+                {
+                    "m.py": """
+                        class Gauge:
+                            def __init__(self, name, doc):
+                                pass
+
+                        A = Gauge("kvtpu_persistence_bytes", "doc")
+                    """
+                },
+                rules=("KV007",),
+            )
+            == []
+        )
+
+    def test_documented_metric_never_registered(self, tmp_path):
+        obs = OBS_MD + "| `ghost_total` | — | never registered |\n"
+        findings = lint_project(
+            tmp_path, {"empty.py": ""}, rules=("KV007",), obs_md=obs
+        )
+        assert [f.rule for f in findings] == ["KV007"]
+        assert "ghost_total" in findings[0].message
+        assert findings[0].path.endswith("observability.md")
+
+    def test_counter_total_suffix_equivalence(self, tmp_path):
+        # Counters register without `_total`; the docs show the
+        # exposition name.  Not drift.
+        obs = OBS_MD + "| `z_total` | — | things |\n"
+        assert (
+            lint_project(
+                tmp_path,
+                {
+                    "m.py": """
+                        class Counter:
+                            def __init__(self, name, doc):
+                                pass
+
+                        A = Counter("kvtpu_z", "doc")
+                    """
+                },
+                rules=("KV007",),
+                obs_md=obs,
+            )
+            == []
+        )
+
+    def test_stage_vocabulary_drift(self, tmp_path):
+        findings = lint_project(
+            tmp_path,
+            {
+                "t.py": """
+                    def span(name):
+                        pass
+
+                    def work():
+                        span("tokenize")
+                        span("bogus.stage")
+                """
+            },
+            rules=("KV007",),
+        )
+        assert [f.rule for f in findings] == ["KV007"]
+        assert "bogus.stage" in findings[0].message
+
+
+class TestKV008ResourceDiscipline:
+    def test_leaked_thread_on_self(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self.run)
+                    self._t.start()
+            """,
+            rules=("KV008",),
+        )
+        assert rule_ids(findings) == ["KV008"]
+        assert "_t" in findings[0].message
+
+    def test_closer_method_passes(self, tmp_path):
+        assert (
+            lint(
+                tmp_path,
+                """
+                import threading
+
+                class W:
+                    def start(self):
+                        self._t = threading.Thread(target=self.run)
+                        self._t.start()
+
+                    def stop(self):
+                        self._t.join()
+                """,
+                rules=("KV008",),
+            )
+            == []
+        )
+
+    def test_closer_reachable_through_call_chain(self, tmp_path):
+        assert (
+            lint(
+                tmp_path,
+                """
+                import threading
+
+                class W:
+                    def start(self):
+                        self._t = threading.Thread(target=self.run)
+
+                    def close(self):
+                        self._halt()
+
+                    def _halt(self):
+                        self._t.join()
+                """,
+                rules=("KV008",),
+            )
+            == []
+        )
+
+    def test_local_assigned_to_self_uses_attr_closer(self, tmp_path):
+        assert (
+            lint(
+                tmp_path,
+                """
+                import socket
+
+                class C:
+                    def connect(self):
+                        sock = socket.socket()
+                        self._sock = sock
+
+                    def close(self):
+                        self._sock.close()
+                """,
+                rules=("KV008",),
+            )
+            == []
+        )
+
+    def test_returned_local_transfers_ownership(self, tmp_path):
+        assert (
+            lint(
+                tmp_path,
+                """
+                import socket
+
+                class C:
+                    def open_socket(self):
+                        sock = socket.socket()
+                        return sock
+                """,
+                rules=("KV008",),
+            )
+            == []
+        )
+
+    def test_purely_local_without_cleanup_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            def kick(run):
+                t = threading.Thread(target=run)
+                t.start()
+            """,
+            rules=("KV008",),
+        )
+        # Module-level function, not a class method: out of scope.
+        assert findings == []
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class K:
+                def kick(self, run):
+                    t = threading.Thread(target=run)
+                    t.start()
+            """,
+            rules=("KV008",),
+        )
+        assert rule_ids(findings) == ["KV008"]
+
+    def test_unrelated_join_does_not_mask_leak(self, tmp_path):
+        # Cleanup calls are receiver-checked: ", ".join(parts) is
+        # string formatting, not thread cleanup.
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class K:
+                def kick(self, run, parts):
+                    t = threading.Thread(target=run)
+                    t.start()
+                    self._label = ", ".join(parts)
+            """,
+            rules=("KV008",),
+        )
+        assert rule_ids(findings) == ["KV008"]
+        assert "thread" in findings[0].message
+
+    def test_join_on_the_local_passes(self, tmp_path):
+        assert (
+            lint(
+                tmp_path,
+                """
+                import threading
+
+                class K:
+                    def run_sync(self, run):
+                        t = threading.Thread(target=run)
+                        t.start()
+                        t.join()
+                """,
+                rules=("KV008",),
+            )
+            == []
+        )
+
+    def test_stop_event_does_not_exempt_sockets(self, tmp_path):
+        # The stop-event factory shape bounds a worker *loop*; it says
+        # nothing about a socket created alongside it.
+        findings = lint(
+            tmp_path,
+            """
+            import socket
+            import threading
+
+            class K:
+                def kick(self, work):
+                    stop = threading.Event()
+                    conn = socket.socket()
+
+                    def loop():
+                        while not stop.wait(1):
+                            work()
+
+                    t = threading.Thread(target=loop)
+                    t.start()
+                    return stop
+            """,
+            rules=("KV008",),
+        )
+        assert rule_ids(findings) == ["KV008"]
+        assert "socket" in findings[0].message
+
+    def test_stop_event_factory_shape_passes(self, tmp_path):
+        assert (
+            lint(
+                tmp_path,
+                """
+                import threading
+
+                class K:
+                    def kick(self, work):
+                        stop = threading.Event()
+
+                        def loop():
+                            while not stop.wait(1):
+                                work()
+
+                        t = threading.Thread(target=loop)
+                        t.start()
+                        return stop
+                """,
+                rules=("KV008",),
+            )
+            == []
+        )
+
+    def test_appended_to_self_list_needs_closer(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class P:
+                def spawn(self):
+                    t = threading.Thread(target=self.run)
+                    t.start()
+                    self._threads.append(t)
+            """,
+            rules=("KV008",),
+        )
+        assert rule_ids(findings) == ["KV008"]
+        assert (
+            lint(
+                tmp_path,
+                """
+                import threading
+
+                class P:
+                    def spawn(self):
+                        t = threading.Thread(target=self.run)
+                        t.start()
+                        self._threads.append(t)
+
+                    def shutdown(self):
+                        for t in self._threads:
+                            t.join()
+                """,
+                rules=("KV008",),
+            )
+            == []
+        )
+
+    def test_suppression(self, tmp_path):
+        assert (
+            lint(
+                tmp_path,
+                """
+                import threading
+
+                class W:
+                    def start(self):
+                        self._t = threading.Thread(target=self.run)  # kvlint: disable=KV008
+                """,
+                rules=("KV008",),
+            )
+            == []
+        )
+
+
+class TestWholeProgramCLI:
+    def test_planted_cycle_via_cli_format(self, tmp_path):
+        pkg = project(tmp_path, CYCLE_FIXTURE)
+        proc = run_cli("--no-baseline", "--rules", "KV006", str(pkg))
+        assert proc.returncode == 1
+        lines = proc.stdout.strip().splitlines()
+        assert lines
+        for line in lines:
+            assert TestCLIContract.OUTPUT_RE.match(line), line
+        assert any("KV006" in line for line in lines)
+
+    def test_planted_undocumented_knob_via_cli(self, tmp_path):
+        pkg = project(
+            tmp_path,
+            {
+                "cfg.py": """
+                    import os
+                    BAD = os.environ.get("SECRET_KNOB")
+                """
+            },
+        )
+        proc = run_cli("--no-baseline", "--rules", "KV007", str(pkg))
+        assert proc.returncode == 1
+        assert "KV007" in proc.stdout
+        assert "SECRET_KNOB" in proc.stdout
+
+
+class TestBaselineRulesScoping:
+    def test_scoped_write_preserves_other_rules_entries(self, tmp_path):
+        """--rules KV005 --write-baseline must not truncate KV008
+        entries the scoped run never recomputed (regression)."""
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            textwrap.dedent(
+                """
+                import threading
+
+                class W:
+                    def start(self):
+                        self._t = threading.Thread(target=self.run)
+
+                def f():
+                    try:
+                        pass
+                    except:
+                        pass
+                """
+            )
+        )
+        baseline = tmp_path / "baseline.txt"
+        proc = run_cli(
+            "--baseline", str(baseline), "--write-baseline", str(bad)
+        )
+        assert proc.returncode == 0
+        full = baseline.read_text()
+        assert "KV005" in full and "KV008" in full
+
+        # Scoped rewrite: only KV005 entries may be regenerated.
+        proc = run_cli(
+            "--baseline",
+            str(baseline),
+            "--rules",
+            "KV005",
+            "--write-baseline",
+            str(bad),
+        )
+        assert proc.returncode == 0
+        scoped = baseline.read_text()
+        assert "KV005" in scoped and "KV008" in scoped
+
+        # And the combined baseline still grandfathers everything.
+        proc = run_cli("--baseline", str(baseline), str(bad))
+        assert proc.returncode == 0, proc.stdout + proc.stderr

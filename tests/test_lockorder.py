@@ -1,0 +1,253 @@
+"""Runtime lock-order watchdog (utils/lockorder.py) — the dynamic half
+of kvlint KV006.
+
+These tests pin the watchdog's contract: identity passthrough when
+disabled (the production path never pays for it), and a
+LockOrderViolation — not a deadlock — for every class of ordering bug
+the static rule reasons about: pair inversion, unranked or descending
+same-name nesting, and same-instance re-acquisition of a non-reentrant
+lock.  Declarations are module-global, so each test builds its own
+names and restores the registries on the way out.
+"""
+
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.utils import lockorder
+from llm_d_kv_cache_manager_tpu.utils.lockorder import (
+    LockOrderViolation,
+    TrackedLock,
+)
+
+
+@pytest.fixture(autouse=True)
+def armed_watchdog():
+    """Enable the watchdog and snapshot/restore the declaration
+    registries so tests can declare throwaway orders without wiping the
+    import-time declarations the rest of the suite relies on."""
+    previous = lockorder.enable(True)
+    pairs = set(lockorder._ordered_pairs)
+    ascending = set(lockorder._ascending)
+    try:
+        yield
+    finally:
+        lockorder.enable(previous)
+        lockorder._ordered_pairs.clear()
+        lockorder._ordered_pairs.update(pairs)
+        lockorder._ascending.clear()
+        lockorder._ascending.update(ascending)
+
+
+class TestGating:
+    def test_disabled_returns_lock_unchanged(self):
+        lockorder.enable(False)
+        lock = threading.Lock()
+        assert lockorder.tracked(lock, "X._lock") is lock
+
+    def test_enabled_wraps(self):
+        lock = lockorder.tracked(threading.Lock(), "X._lock")
+        assert isinstance(lock, TrackedLock)
+        assert lock.name == "X._lock"
+
+    def test_wrapper_proxies_context_manager_and_locked(self):
+        lock = lockorder.tracked(threading.Lock(), "X._lock")
+        with lock:
+            assert lock.locked()
+        assert not lock.locked()
+
+
+class TestPairOrder:
+    def test_declared_direction_passes(self):
+        lockorder.declare_order("T.outer", "T.inner")
+        outer = lockorder.tracked(threading.Lock(), "T.outer")
+        inner = lockorder.tracked(threading.Lock(), "T.inner")
+        with outer:
+            with inner:
+                assert [name for name, _ in lockorder.held()] == [
+                    "T.outer",
+                    "T.inner",
+                ]
+        assert lockorder.held() == []
+
+    def test_inversion_raises(self):
+        lockorder.declare_order("T.outer", "T.inner")
+        outer = lockorder.tracked(threading.Lock(), "T.outer")
+        inner = lockorder.tracked(threading.Lock(), "T.inner")
+        with inner:
+            with pytest.raises(LockOrderViolation, match="declared order"):
+                outer.acquire()
+        # The failed acquire must not leave a phantom hold behind.
+        assert lockorder.held() == []
+
+    def test_violation_is_assertion_error(self):
+        # Storm tests assert-on-failure; the watchdog must feed that.
+        assert issubclass(LockOrderViolation, AssertionError)
+
+
+class TestAscending:
+    def test_ascending_ranks_pass(self):
+        lockorder.declare_ascending("T.shard")
+        shards = [
+            lockorder.tracked(threading.Lock(), "T.shard", rank=i)
+            for i in range(4)
+        ]
+        with shards[0], shards[2], shards[3]:
+            pass
+
+    def test_descending_ranks_raise(self):
+        lockorder.declare_ascending("T.shard")
+        lo = lockorder.tracked(threading.Lock(), "T.shard", rank=1)
+        hi = lockorder.tracked(threading.Lock(), "T.shard", rank=2)
+        with hi:
+            with pytest.raises(LockOrderViolation, match="ascending"):
+                lo.acquire()
+
+    def test_equal_rank_raises(self):
+        lockorder.declare_ascending("T.shard")
+        a = lockorder.tracked(threading.Lock(), "T.shard", rank=1)
+        b = lockorder.tracked(threading.Lock(), "T.shard", rank=1)
+        with a:
+            with pytest.raises(LockOrderViolation):
+                b.acquire()
+
+    def test_unranked_nesting_raises(self):
+        lockorder.declare_ascending("T.shard")
+        a = lockorder.tracked(threading.Lock(), "T.shard")
+        b = lockorder.tracked(threading.Lock(), "T.shard")
+        with a:
+            with pytest.raises(LockOrderViolation):
+                b.acquire()
+
+    def test_undeclared_same_name_nesting_raises(self):
+        a = lockorder.tracked(threading.Lock(), "T.undeclared", rank=0)
+        b = lockorder.tracked(threading.Lock(), "T.undeclared", rank=1)
+        with a:
+            with pytest.raises(LockOrderViolation, match="ascending"):
+                b.acquire()
+
+
+class TestReacquisition:
+    def test_plain_lock_self_reacquire_raises(self):
+        lock = lockorder.tracked(threading.Lock(), "T.lock")
+        with lock:
+            with pytest.raises(
+                LockOrderViolation, match="self-deadlocks"
+            ):
+                lock.acquire()
+
+    def test_rlock_reenters_freely(self):
+        lock = lockorder.tracked(threading.RLock(), "T.rlock")
+        with lock:
+            with lock:
+                pass
+        assert lockorder.held() == []
+
+    def test_condition_wait_notify_flow(self):
+        cond = lockorder.tracked(threading.Condition(), "T.cond")
+        hits = []
+
+        def waiter():
+            with cond:
+                while not hits:
+                    cond.wait(1.0)
+
+        thread = threading.Thread(target=waiter)
+        thread.start()
+        with cond:
+            hits.append(1)
+            cond.notify_all()
+        thread.join(timeout=5)
+        assert not thread.is_alive()
+
+
+class TestThreadIsolation:
+    def test_held_stacks_are_per_thread(self):
+        lockorder.declare_order("T.a", "T.b")
+        a = lockorder.tracked(threading.Lock(), "T.a")
+        b = lockorder.tracked(threading.Lock(), "T.b")
+        errors = []
+
+        def other():
+            # This thread holds nothing: acquiring b alone is legal
+            # even while the main thread holds a.
+            try:
+                with b:
+                    pass
+            except LockOrderViolation as exc:  # pragma: no cover
+                errors.append(exc)
+
+        with a:
+            thread = threading.Thread(target=other)
+            thread.start()
+            thread.join(timeout=5)
+        assert not errors
+
+    def test_storm_catches_planted_inversion(self):
+        """End-to-end: threads taking two locks in opposite orders —
+        the bug class the storms would only hit as a rare hang — is
+        caught deterministically as a violation by whichever thread
+        runs the inverted path."""
+        lockorder.declare_order("T.first", "T.second")
+        first = lockorder.tracked(threading.Lock(), "T.first")
+        second = lockorder.tracked(threading.Lock(), "T.second")
+        caught = []
+
+        def inverted():
+            try:
+                with second:
+                    with first:
+                        pass
+            except LockOrderViolation as exc:
+                caught.append(exc)
+
+        thread = threading.Thread(target=inverted)
+        thread.start()
+        thread.join(timeout=5)
+        assert len(caught) == 1
+
+
+class TestProductionDeclarations:
+    """The shipped modules' import-time declarations drive real
+    structures correctly under the watchdog."""
+
+    def test_sharded_index_cross_shard_ops(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+            InMemoryIndex,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+            InMemoryIndexConfig,
+            PodEntry,
+        )
+
+        index = InMemoryIndex(InMemoryIndexConfig(size=64, shards=4))
+        pod = PodEntry("pod-a", "hbm")
+        keys = list(range(16))
+        index.add(keys, keys, [pod])
+        index.lookup(keys)
+        entries, engine_map = index.dump_entries()
+        assert entries
+        index.restore_entries(entries, engine_map)
+        assert index.purge_pod("pod-a") > 0
+
+    def test_persistence_snapshot_nesting(self, tmp_path):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+            InMemoryIndex,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+            InMemoryIndexConfig,
+            PodEntry,
+        )
+        from llm_d_kv_cache_manager_tpu.persistence.recovery import (
+            PersistenceConfig,
+            PersistenceManager,
+        )
+
+        manager = PersistenceManager(
+            PersistenceConfig(directory=str(tmp_path))
+        )
+        index = InMemoryIndex(InMemoryIndexConfig(size=64))
+        index.add([1], [1], [PodEntry("pod-a", "hbm")])
+        info = manager.snapshot(index)
+        assert info.block_keys == 1
+        assert manager.status()["snapshot_path"] == info.path
