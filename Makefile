@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -67,6 +67,13 @@ obs-smoke:
 # asserting sane output + fast-lane score parity (docs/performance.md).
 perf-smoke:
 	$(CPU_ENV) $(PYTHON) hack/perf_smoke.py
+
+# Event-plane smoke (same invocation as CI's "Event-plane smoke"
+# step): consolidated poller over ~64 inproc publishers — throughput
+# floor, thread ceiling, zero cross-pod sheds under a chatty flood,
+# forced gap -> resync, restart classification (docs/event-plane.md).
+events-smoke:
+	$(CPU_ENV) $(PYTHON) hack/events_smoke.py
 
 # Fleet-routing benchmark; on TPU hardware drop JAX_PLATFORMS.
 bench:

@@ -70,8 +70,13 @@ from __future__ import annotations
 import json
 import os
 import random
+import shutil
+import subprocess
 import sys
+import tempfile
+import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -219,6 +224,24 @@ def emit_result(full: dict, probe: dict) -> None:
             "warm_speedup_vs_off": read_path.get("warm_speedup_vs_off"),
             "parity": read_path.get("parity"),
         }
+    event_storm = detail.get("event_storm") or {}
+    event_storm_compact = None
+    if event_storm and "n_pods" in event_storm:
+        gap = event_storm.get("gap_storm") or {}
+        fairness = event_storm.get("fairness") or {}
+        consolidated = event_storm.get("consolidated_pollers_1") or {}
+        event_storm_compact = {
+            "n_pods": event_storm.get("n_pods"),
+            "apply_msgs_per_sec": consolidated.get("apply_msgs_per_sec"),
+            "speedup_vs_threads": event_storm.get(
+                "speedup_vs_thread_baseline"
+            ),
+            "threads": consolidated.get("event_plane_threads"),
+            "fairness_ok": fairness.get("property_holds"),
+            "gap_recovery_s": gap.get("recovery_wall_s"),
+            "staleness_mean_s": gap.get("staleness_mean_s"),
+            "consistency": gap.get("post_resync_consistency"),
+        }
     compact = {
         "metric": full["metric"],
         "value": full["value"],
@@ -227,6 +250,7 @@ def emit_result(full: dict, probe: dict) -> None:
         "device": detail.get("device"),
         "routing_precise_us": detail.get("routing_precise_us"),
         "read_path": read_path_compact,
+        "event_storm": event_storm_compact,
         "indexer_restart": detail.get("indexer_restart"),
         "elapsed_s": detail.get("elapsed_s"),
         "results": results_path or "WRITE FAILED (stderr has why)",
@@ -237,7 +261,13 @@ def emit_result(full: dict, probe: dict) -> None:
     # Belt and braces: every field above is small by construction, but
     # the budget is a hard driver contract — shed optional fields
     # before ever printing an oversized last line.
-    for key in ("indexer_restart", "read_path", "routing_precise_us", "results"):
+    for key in (
+        "indexer_restart",
+        "event_storm",
+        "read_path",
+        "routing_precise_us",
+        "results",
+    ):
         if len(line) <= HEADLINE_MAX_BYTES:
             break
         compact.pop(key, None)
@@ -245,9 +275,17 @@ def emit_result(full: dict, probe: dict) -> None:
     _probe_status_line(probe)
     print(line, flush=True)
 
+import zmq
+
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    IndexConfig,
+    InMemoryIndexConfig,
+)
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+    ChunkedTokenDatabase,
     TokenProcessorConfig,
 )
 from llm_d_kv_cache_manager_tpu.kvevents.events import (
@@ -256,6 +294,7 @@ from llm_d_kv_cache_manager_tpu.kvevents.events import (
     EventBatch,
 )
 from llm_d_kv_cache_manager_tpu.kvevents.pool import Message, Pool, PoolConfig
+from llm_d_kv_cache_manager_tpu.metrics.collector import counter_total
 from llm_d_kv_cache_manager_tpu.models import llama
 from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import Encoding
 
@@ -1872,6 +1911,721 @@ def maybe_bench_read_path(context: str) -> dict:
     return bench_read_path()
 
 
+# ---------------- event_storm: fleet-scale event-plane regime ----------
+
+_STORM_TINY = bool(os.environ.get("KVTPU_BENCH_TINY"))
+STORM_PODS = int(
+    os.environ.get(
+        "KVTPU_BENCH_STORM_PODS", "64" if _STORM_TINY else "1000"
+    )
+)
+STORM_PUBLISH_S = _env_float(
+    "KVTPU_BENCH_STORM_S", 1.0 if _STORM_TINY else 3.0
+)
+STORM_BLOCK_SIZE = 16
+# Offered load for the throughput cells, msgs/s across the whole
+# fleet.  Must exceed the apply capacity of every cell so each one is
+# measured at saturation (sustained capacity), not at whatever rate
+# the load generator happened to reach.
+STORM_RATE = _env_float("KVTPU_BENCH_STORM_RATE", 6000.0)
+
+
+def _hist_stats(hist) -> tuple:
+    """(sum, count) of an unlabeled prometheus histogram."""
+    total = count = 0.0
+    for metric in hist.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_sum"):
+                total = sample.value
+            elif sample.name.endswith("_count"):
+                count = sample.value
+    return total, count
+
+
+def _pod_labeled_totals(counter, pods) -> dict:
+    """pod -> value for a pod-labeled counter, 0.0 when never touched."""
+    wanted = set(pods)
+    out = {pod: 0.0 for pod in wanted}
+    for metric in counter.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total"):
+                pod = sample.labels.get("pod")
+                if pod in wanted:
+                    out[pod] = sample.value
+    return out
+
+
+def _event_plane_threads() -> int:
+    """Threads belonging to the event plane: pollers (consolidated),
+    legacy per-pod subscriber threads (baseline), pool workers, and the
+    resync worker."""
+    prefixes = ("kvtpu-evplane-", "kvtpu-events-", "kvtpu-zmq-")
+    return sum(
+        1
+        for t in threading.enumerate()
+        if any(t.name.startswith(p) for p in prefixes)
+    )
+
+
+class _StormFleet:
+    """N simulated publishers over inproc: raw PUB sockets + per-pod
+    seq counters, sending pre-encoded payloads so the publish side
+    never bottlenecks the measurement (the apply path is the subject).
+    """
+
+    def __init__(self, context, n_pods: int, run_id: str) -> None:
+        import struct as _struct
+
+        self._struct = _struct
+        self.context = context
+        self.pods = [f"storm-{run_id}-{i}" for i in range(n_pods)]
+        self.endpoints = {
+            pod: f"inproc://{pod}" for pod in self.pods
+        }
+        self.socks = {}
+        for pod in self.pods:
+            sock = context.socket(zmq.PUB)
+            sock.setsockopt(zmq.LINGER, 0)
+            sock.bind(self.endpoints[pod])
+            self.socks[pod] = sock
+        self.topics = {
+            pod: f"kv@{pod}@{MODEL_NAME}".encode() for pod in self.pods
+        }
+        self.seq = {pod: 0 for pod in self.pods}
+        # One shared payload: distinct engine keys per pod are not
+        # needed for the throughput cells (shared blocks across pods
+        # are realistic), and the apply-side token hashing dominates
+        # regardless.
+        tokens = list(range(2 * STORM_BLOCK_SIZE))
+        self.payload = EventBatch(
+            ts=0.0,
+            events=[
+                BlockStored(
+                    block_hashes=[0xBEEF, 0xCAFE],
+                    parent_block_hash=None,
+                    token_ids=tokens,
+                    block_size=STORM_BLOCK_SIZE,
+                )
+            ],
+        ).encode()
+
+    def publish_raw(self, pod: str, payload=None) -> None:
+        self.seq[pod] += 1
+        self.socks[pod].send_multipart(
+            [
+                self.topics[pod],
+                self._struct.pack(">Q", self.seq[pod]),
+                payload if payload is not None else self.payload,
+            ]
+        )
+
+    def skip_seq(self, pod: str, count: int) -> None:
+        self.seq[pod] += count
+
+    def close(self) -> None:
+        for sock in self.socks.values():
+            sock.close()
+
+
+def _storm_pool(index=None, start=True, **kw):
+    index = index or InMemoryIndex(InMemoryIndexConfig(size=2_000_000))
+    db = ChunkedTokenDatabase(
+        TokenProcessorConfig(block_size=STORM_BLOCK_SIZE)
+    )
+    pool = Pool(index, db, PoolConfig(**kw))
+    if start:
+        pool.start()
+    return pool, index, db
+
+
+def _wait_join(fleet, pods, seen, deadline_s: float = 60.0) -> int:
+    """Publish warmup rounds until every pod's subscription is live
+    (PUB/SUB is lossy pre-join); returns pods joined."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline and len(seen) < len(pods):
+        for pod in pods:
+            if pod not in seen:
+                fleet.publish_raw(pod)
+        time.sleep(0.05)
+    return len(seen)
+
+
+# Standalone publisher process for the throughput cells.  Publishing
+# must happen OUTSIDE the measured process: in production the
+# publishers are remote vLLM pods, and an in-process load generator
+# shares the GIL with the subscription layer under test — under the
+# thread-per-pod baseline its 1000+ threads starve the generator until
+# offered load collapses to whatever the baseline can absorb, and the
+# A/B degenerates to comparing publish rates.  SNDHWM=0 so a saturated
+# cell backs up into the publisher's buffers instead of dropping
+# (drops would read as forced seq gaps and poison the gap metrics).
+_STORM_PUBLISHER_SRC = r"""
+import json, os, struct, sys, time
+import zmq
+
+spec = json.load(open(sys.argv[1]))
+go_path = sys.argv[2]
+endpoints = spec["endpoints"]
+topics = {pod: t.encode() for pod, t in spec["topics"].items()}
+payload = bytes.fromhex(spec["payload_hex"])
+rate = float(spec["rate"])
+deadline = time.monotonic() + float(spec["duration"])
+
+ctx = zmq.Context()
+ctx.set(zmq.MAX_SOCKETS, max(4096, 2 * len(endpoints)))
+socks = {}
+for pod, endpoint in endpoints.items():
+    s = ctx.socket(zmq.PUB)
+    s.setsockopt(zmq.LINGER, 0)
+    s.setsockopt(zmq.SNDHWM, 0)
+    s.bind(endpoint)
+    socks[pod] = s
+seq = {pod: 0 for pod in endpoints}
+pods = list(endpoints)
+pass_s = len(pods) / rate if rate else 0.0
+# Warmup: one gentle pass per 0.5s until the parent (having seen a
+# message from every pod) drops the go-file — joining at full offered
+# load would saturate a slow cell before its fleet ever finished
+# subscribing.  Then publish at the saturation rate.
+while time.monotonic() < deadline:
+    go = os.path.exists(go_path)
+    t0 = time.monotonic()
+    for pod in pods:
+        seq[pod] += 1
+        socks[pod].send_multipart(
+            [topics[pod], struct.pack(">Q", seq[pod]), payload]
+        )
+    sleep_s = (pass_s if go else 0.5) - (time.monotonic() - t0)
+    if sleep_s > 0:
+        time.sleep(sleep_s)
+for s in socks.values():
+    s.close()
+ctx.term()
+"""
+
+
+def _spawn_storm_publisher(
+    workdir: str,
+    endpoints: Dict[str, str],
+    payload: bytes,
+    rate: float,
+    duration: float,
+) -> Tuple[subprocess.Popen, str]:
+    spec = {
+        "endpoints": endpoints,
+        "topics": {
+            pod: f"kv@{pod}@{MODEL_NAME}" for pod in endpoints
+        },
+        "payload_hex": payload.hex(),
+        "rate": rate,
+        "duration": duration,
+    }
+    src_path = os.path.join(workdir, "publisher.py")
+    spec_path = os.path.join(workdir, "spec.json")
+    go_path = os.path.join(workdir, "go")
+    with open(src_path, "w") as f:
+        f.write(_STORM_PUBLISHER_SRC)
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    proc = subprocess.Popen(
+        [sys.executable, src_path, spec_path, go_path],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    return proc, go_path
+
+
+def _storm_throughput_cell(
+    pods, endpoints, payload, attach, detach, publish_s: float
+) -> dict:
+    """One apply-throughput cell: attach subscriptions for `pods`,
+    spawn the external publisher at STORM_RATE (above every cell's
+    capacity), wait for join, and measure APPLY completions inside a
+    `publish_s` window — the sustained ingest capacity with the
+    subscription layer's own overhead (poller vs 1000 threads) on the
+    same CPUs.  The backlog left in sockets dies with detach (LINGER
+    0); the pool's own backlog is drained after the measurement, not
+    counted: folding an unbounded drain tail into the rate made the
+    number depend on backlog luck, not capacity."""
+    from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+    pool, _index, _db = _storm_pool(concurrency=4)
+    seen = set()
+    seen_lock = threading.Lock()
+
+    def sink(message):
+        with seen_lock:
+            seen.add(message.pod_identifier)
+        pool.add_task(message)
+
+    attach(sink)
+    workdir = tempfile.mkdtemp(prefix="kvtpu-storm-pub-")
+    proc = None
+    detached = False
+    try:
+        proc, go_path = _spawn_storm_publisher(
+            workdir,
+            endpoints,
+            payload,
+            STORM_RATE,
+            duration=150.0 + publish_s,
+        )
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline and len(seen) < len(pods):
+            time.sleep(0.05)
+        joined = len(seen)
+        # Full join reached (at gentle warmup load): release the
+        # saturation rate, give the backlog a moment to build, then
+        # measure the steady state.
+        with open(go_path, "w"):
+            pass
+        time.sleep(1.0)
+        drained_before, _ = _hist_stats(METRICS.kvevents_batch_size)
+        dropped_before = counter_total(METRICS.kvevents_dropped)
+        threads = _event_plane_threads()
+
+        t0 = time.perf_counter()
+        time.sleep(publish_s)
+        elapsed = time.perf_counter() - t0
+        drained_after, _ = _hist_stats(METRICS.kvevents_batch_size)
+        applied = drained_after - drained_before
+        # Detach BEFORE draining the pool backlog: the subscription
+        # layer's overhead belongs in the window, not in the cleanup.
+        detach()
+        detached = True
+        proc.terminate()
+        proc.wait(timeout=30)
+        pool.drain()
+        return {
+            "pods": len(pods),
+            "pods_joined": joined,
+            "offered_msgs_per_sec": STORM_RATE,
+            "applied_msgs_in_window": int(applied),
+            "apply_msgs_per_sec": round(applied / elapsed, 1),
+            "dropped": int(
+                counter_total(METRICS.kvevents_dropped) - dropped_before
+            ),
+            "event_plane_threads": threads,
+            "window_s": round(elapsed, 2),
+        }
+    finally:
+        if not detached:
+            detach()
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        pool.shutdown()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def bench_event_storm(
+    n_pods: Optional[int] = None, publish_s: Optional[float] = None
+) -> dict:
+    """detail.event_storm regime (docs/event-plane.md): the full
+    subscribe -> demux -> shard-lane -> batched-apply path at fleet
+    scale, device-free.
+
+    Cells: consolidated poller (pollers=1 and pollers=4) vs the legacy
+    thread-per-pod baseline at equal publish load (apply throughput +
+    event-plane thread count); per-pod flow control on vs off under a
+    deliberately chatty pod (fairness: an under-budget pod must never
+    be shed); and a forced 10%-gap storm with inventory resync
+    (gap-recovery wall time, per-pod staleness window, post-resync
+    index consistency vs the publishers' ground truth)."""
+    from llm_d_kv_cache_manager_tpu.kvevents.poller import (
+        ChannelConfig,
+        PollerPool,
+        PollerPoolConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.resync import (
+        CallableInventorySource,
+        InventoryBlock,
+        PodInventory,
+        ResyncConfig,
+        ResyncManager,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+        ZMQSubscriber,
+        ZMQSubscriberConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+    n = STORM_PODS if n_pods is None else n_pods
+    window = STORM_PUBLISH_S if publish_s is None else publish_s
+    run_id = uuid.uuid4().hex[:8]
+    # Dedicated context: the fleet needs ~2N sockets and libzmq's
+    # default max_sockets is 1023 — at N=1000 most SUB opens would
+    # fail (and surface only as endless reconnect backoff).
+    context = zmq.Context(2)
+    context.set(zmq.MAX_SOCKETS, max(4096, 4 * n))
+    fleet = _StormFleet(context, n, run_id)
+    # The throughput cells subscribe over ipc to an EXTERNAL publisher
+    # process (see _STORM_PUBLISHER_SRC); the inproc fleet above feeds
+    # the gap/fairness logic cells, where publish volume is tiny.
+    ipc_dir = tempfile.mkdtemp(prefix="kvtpu-storm-ipc-")
+    storm_endpoints = {
+        pod: f"ipc://{ipc_dir}/p{i}" for i, pod in enumerate(fleet.pods)
+    }
+    result: dict = {
+        "n_pods": n,
+        "publish_seconds": window,
+        "block_size": STORM_BLOCK_SIZE,
+        "offered_rate_msgs_per_sec": STORM_RATE,
+    }
+    try:
+        # -- consolidated poller cells --------------------------------
+        for pollers in (1, 4):
+            _progress(
+                f"event_storm: consolidated pollers={pollers}, N={n}"
+            )
+            ppool = PollerPool(
+                context=context,
+                config=PollerPoolConfig(
+                    pollers=pollers, poll_interval_ms=20
+                ),
+            )
+            channels = []
+
+            def attach(sink, ppool=ppool, channels=channels):
+                for pod in fleet.pods:
+                    channels.append(
+                        ppool.attach(
+                            ChannelConfig(
+                                endpoint=storm_endpoints[pod],
+                                pod_identifier=pod,
+                            ),
+                            sink,
+                        )
+                    )
+
+            def detach(ppool=ppool, channels=channels):
+                for channel in channels:
+                    ppool.detach(channel)
+                ppool.shutdown()
+
+            cell = _storm_throughput_cell(
+                fleet.pods,
+                storm_endpoints,
+                fleet.payload,
+                attach,
+                detach,
+                window,
+            )
+            # The headline thread claim: the event plane is
+            # pollers + pool workers, independent of N.
+            cell["thread_ceiling"] = pollers + 4
+            cell["thread_ceiling_ok"] = (
+                cell["event_plane_threads"] <= cell["thread_ceiling"]
+            )
+            result[f"consolidated_pollers_{pollers}"] = cell
+
+        # -- legacy thread-per-pod baseline ---------------------------
+        _progress(f"event_storm: thread-per-pod baseline, N={n}")
+        subscribers = []
+
+        def attach_baseline(sink):
+            for pod in fleet.pods:
+                sub = ZMQSubscriber(
+                    ZMQSubscriberConfig(
+                        endpoint=storm_endpoints[pod],
+                        pod_identifier=pod,
+                    ),
+                    sink,
+                    context=context,
+                )
+                sub.start()
+                subscribers.append(sub)
+
+        def detach_baseline():
+            for sub in subscribers:
+                sub._stop.set()
+            for sub in subscribers:
+                sub.stop()
+
+        baseline = _storm_throughput_cell(
+            fleet.pods,
+            storm_endpoints,
+            fleet.payload,
+            attach_baseline,
+            detach_baseline,
+            window,
+        )
+        result["baseline_thread_per_pod"] = baseline
+        consolidated = result["consolidated_pollers_1"]
+        result["speedup_vs_thread_baseline"] = (
+            round(
+                consolidated["apply_msgs_per_sec"]
+                / baseline["apply_msgs_per_sec"],
+                2,
+            )
+            if baseline["apply_msgs_per_sec"]
+            else None
+        )
+
+        # -- fairness: per-pod budget on vs off ------------------------
+        result["fairness"] = _storm_fairness_cells(
+            context, fleet, run_id
+        )
+
+        # -- forced gap storm + resync --------------------------------
+        result["gap_storm"] = _storm_gap_cell(
+            context,
+            fleet,
+            METRICS,
+            CallableInventorySource,
+            InventoryBlock,
+            PodInventory,
+            ResyncConfig,
+            ResyncManager,
+        )
+        return result
+    finally:
+        fleet.close()
+        context.term()
+        shutil.rmtree(ipc_dir, ignore_errors=True)
+
+
+def _storm_fairness_cells(context, fleet, run_id: str) -> dict:
+    """Deterministic fairness A/B at the pool layer: 8 quiet pods
+    enqueue 5 messages each (well under the effective budget,
+    64 // 9 = 7), then one chatty pod bursts 2000 into the same shard
+    of an unstarted pool (so the backlog is real, as in a storm).  With
+    per-pod flow control ON the chatty pod pays for its own flood and
+    no quiet message may be shed; OFF (legacy global FIFO, drop-oldest)
+    the quiet pods — whose messages are the oldest — are shed first:
+    exactly the starvation mode the lanes exist to kill."""
+    from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+    chatty = "storm-fair-chatty"
+    quiet = [f"storm-fair-quiet-{i}" for i in range(8)]
+    payload = fleet.payload
+    cells = {}
+    for mode, per_pod in (("budget_on", True), ("budget_off", False)):
+        _progress(f"event_storm: fairness {mode}")
+        # Enqueue-only (never started): the cell measures shedding
+        # against a standing backlog, the storm's worst case.
+        pool, _index, _db = _storm_pool(
+            start=False,
+            concurrency=1,
+            max_queue_depth=64,
+            per_pod_flow_control=per_pod,
+        )
+        shed_before = _pod_labeled_totals(
+            METRICS.kvevents_pod_shed, [chatty] + quiet
+        )
+
+        def enqueue(pod, i):
+            pool.add_task(
+                Message(
+                    topic=f"kv@{pod}@{MODEL_NAME}",
+                    payload=payload,
+                    pod_identifier=pod,
+                    model_name=MODEL_NAME,
+                    seq=i,
+                )
+            )
+
+        for i in range(5):
+            for pod in quiet:
+                enqueue(pod, i)
+        for i in range(2000):
+            enqueue(chatty, i)
+        shed_after = _pod_labeled_totals(
+            METRICS.kvevents_pod_shed, [chatty] + quiet
+        )
+        quiet_shed = sum(shed_after[p] - shed_before[p] for p in quiet)
+        quiet_queued = sum(
+            depth
+            for q in pool._queues
+            for pod, depth in q.lane_depths().items()
+            if pod in quiet
+        )
+        cells[mode] = {
+            "chatty_shed": int(shed_after[chatty] - shed_before[chatty]),
+            "quiet_shed": int(quiet_shed),
+            "quiet_queued": quiet_queued,
+        }
+        pool.start()
+        pool.drain()
+        pool.shutdown()
+    cells["property_holds"] = (
+        cells["budget_on"]["quiet_shed"] == 0
+        and cells["budget_on"]["quiet_queued"] == 40
+    )
+    return cells
+
+
+def _storm_gap_cell(
+    context,
+    fleet,
+    METRICS,
+    CallableInventorySource,
+    InventoryBlock,
+    PodInventory,
+    ResyncConfig,
+    ResyncManager,
+) -> dict:
+    """Force seq gaps on 10% of the fleet and measure the resync loop:
+    recovery wall time, staleness window, post-resync consistency."""
+    from llm_d_kv_cache_manager_tpu.kvevents.poller import (
+        ChannelConfig,
+        PollerPool,
+        PollerPoolConfig,
+    )
+
+    _progress("event_storm: 10% gap storm + resync")
+    rng = random.Random(7)
+    gap_pods = fleet.pods[: max(1, len(fleet.pods) // 10)]
+    pool, index, db = _storm_pool(concurrency=4)
+
+    # Ground truth: each pod "stores" one private 2-block chain; the
+    # inventory source serves it back on resync.
+    truth = {}
+    for pod in fleet.pods:
+        base = rng.randrange(1, 1 << 30)
+        tokens = [
+            (base + j) % 30000 + 1 for j in range(2 * STORM_BLOCK_SIZE)
+        ]
+        truth[pod] = InventoryBlock(
+            block_hashes=[base * 2 + 1, base * 2 + 2],
+            token_ids=tokens,
+            block_size=STORM_BLOCK_SIZE,
+            medium="hbm",
+        )
+
+    source = CallableInventorySource(
+        lambda pod: PodInventory(
+            pod_identifier=pod,
+            model_name=MODEL_NAME,
+            blocks=[truth[pod]],
+        )
+    )
+    resync = ResyncManager(
+        pool, source, ResyncConfig(apply_timeout_s=60.0)
+    )
+    resync.start()
+
+    seen = set()
+    seen_lock = threading.Lock()
+
+    def sink(message):
+        with seen_lock:
+            seen.add(message.pod_identifier)
+        pool.add_task(message)
+
+    ppool = PollerPool(
+        context=context,
+        config=PollerPoolConfig(pollers=1, poll_interval_ms=10),
+    )
+    manager_channels = {
+        pod: ppool.attach(
+            ChannelConfig(
+                endpoint=fleet.endpoints[pod], pod_identifier=pod
+            ),
+            sink,
+            on_gap=resync.gap_listener,
+        )
+        for pod in fleet.pods
+    }
+    try:
+        _wait_join(fleet, fleet.pods, seen)
+        # Phase 1: every pod stores its ground-truth chain.
+        for pod in fleet.pods:
+            block = truth[pod]
+            fleet.publish_raw(
+                pod,
+                EventBatch(
+                    ts=0.0,
+                    events=[
+                        BlockStored(
+                            block_hashes=list(block.block_hashes),
+                            parent_block_hash=None,
+                            token_ids=list(block.token_ids),
+                            block_size=block.block_size,
+                            medium="hbm",
+                        )
+                    ],
+                ).encode(),
+            )
+        time.sleep(0.5)
+        pool.drain()
+
+        staleness_sum0, staleness_n0 = _hist_stats(
+            METRICS.kvevents_resync_staleness
+        )
+        # Phase 2: force a gap on 10% of pods (skip 5 seqs, then one
+        # live message so the tracker sees the jump).
+        t0 = time.perf_counter()
+        for pod in gap_pods:
+            fleet.skip_seq(pod, 5)
+            fleet.publish_raw(pod)
+        # Recovery = every forced gap DETECTED (resync attempted) and
+        # the suspect set drained again — not just "no suspects yet".
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            stats = resync.stats()
+            outcomes = stats["resyncs_ok"] + stats["resyncs_failed"]
+            if outcomes >= len(gap_pods) and not stats["suspect"]:
+                break
+            time.sleep(0.05)
+        recovery_s = time.perf_counter() - t0
+        stats = resync.stats()
+        staleness_sum1, staleness_n1 = _hist_stats(
+            METRICS.kvevents_resync_staleness
+        )
+        resynced = int(staleness_n1 - staleness_n0)
+
+        # Post-resync consistency: every gapped pod's ground-truth
+        # chain must be claimed by exactly that pod again.
+        consistent = 0
+        for pod in gap_pods:
+            keys = db.tokens_to_kv_block_keys(
+                EMPTY_BLOCK_HASH, truth[pod].token_ids, MODEL_NAME
+            )
+            found = index.lookup(keys)
+            if set(found) == set(keys) and all(
+                any(e.pod_identifier == pod for e in entries)
+                for entries in found.values()
+            ):
+                consistent += 1
+        return {
+            "gap_pods": len(gap_pods),
+            "resynced": resynced,
+            "resyncs_failed": stats["resyncs_failed"],
+            "still_suspect": len(stats["suspect"]),
+            "recovery_wall_s": round(recovery_s, 3),
+            "staleness_mean_s": (
+                round(
+                    (staleness_sum1 - staleness_sum0) / resynced, 4
+                )
+                if resynced
+                else None
+            ),
+            "post_resync_consistency": (
+                round(consistent / len(gap_pods), 4) if gap_pods else None
+            ),
+        }
+    finally:
+        for channel in manager_channels.values():
+            ppool.detach(channel)
+        ppool.shutdown()
+        resync.close()
+        pool.shutdown()
+
+
+def maybe_bench_event_storm(context: str) -> dict:
+    """bench_event_storm under the degrade contract."""
+    if _over_budget(reserve_s=90.0):
+        return {"truncated": True}
+    _progress(f"{context}: event_storm fleet regime (N={STORM_PODS})")
+    try:
+        return bench_event_storm()
+    except Exception as exc:  # noqa: BLE001 — optional layer
+        logger_exc = f"{type(exc).__name__}: {exc}"
+        _progress(f"event_storm failed: {logger_exc}")
+        return {"error": logger_exc[:300]}
+
+
 def _routing_percentiles(samples: Sequence[float]) -> Optional[dict]:
     if not samples:
         return None
@@ -1907,6 +2661,7 @@ def emit_cpu_fallback(device_error: str, probe: dict) -> None:
     )
     micro = maybe_bench_micro("fallback")
     read_path = maybe_bench_read_path("fallback")
+    event_storm = maybe_bench_event_storm("fallback")
     indexer_restart = maybe_bench_indexer_restart(
         requests, hashes_list, t_miss, t_hit, ideal_service
     )
@@ -1932,6 +2687,7 @@ def emit_cpu_fallback(device_error: str, probe: dict) -> None:
                 ),
                 "micro": micro,
                 "read_path": read_path,
+                "event_storm": event_storm,
                 "indexer_restart": indexer_restart,
                 "requests": len(requests),
                 "elapsed_s": round(_elapsed(), 1),
@@ -2126,6 +2882,11 @@ def main() -> None:
     # vs off + parity), device-free.
     read_path = maybe_bench_read_path("detail.read_path")
 
+    # detail.event_storm: fleet-scale event-plane regime (consolidated
+    # poller vs thread-per-pod, per-pod fairness, gap->resync),
+    # device-free.
+    event_storm = maybe_bench_event_storm("detail.event_storm")
+
     # Persistence regime: cold vs warm-recovered routing across an
     # indexer restart (uses the measured service times).
     indexer_restart = maybe_bench_indexer_restart(
@@ -2171,6 +2932,7 @@ def main() -> None:
                 ),
                 "micro": micro,
                 "read_path": read_path,
+                "event_storm": event_storm,
                 "indexer_restart": indexer_restart,
                 "service_times": "measured",
                 "service_miss_s": round(t_miss, 4),

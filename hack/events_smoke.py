@@ -1,0 +1,335 @@
+"""Event-plane smoke: consolidated poller + flow control + gap resync.
+
+CI gate (`make events-smoke`): boots the consolidated poller with ~64
+inproc publishers through the REAL path (PUB socket -> PollerPool demux
+-> shard lanes -> batched apply -> index) and asserts the event-plane
+contracts from docs/event-plane.md:
+
+* every pod's subscription becomes live and a modest throughput floor
+  is sustained (machinery gate, deliberately far below real capacity —
+  CI boxes are noisy, so the floor only catches wedges, not
+  regressions-by-percent);
+* the event plane runs within its thread ceiling
+  (pollers + pool workers + resync worker), independent of pod count;
+* per-pod flow control: a chatty pod's flood sheds ONLY the chatty pod
+  (zero cross-pod sheds — the fairness property);
+* a forced sequence gap marks the pod suspect and the anti-entropy
+  resync repairs it: suspect set drains, the staleness histogram gains
+  a sample, and the pod's inventory chain is re-claimed in the index;
+* a publisher seq regression counts as a restart, not a gap.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+import threading
+import time
+import uuid
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    import zmq
+
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+        EMPTY_BLOCK_HASH,
+        ChunkedTokenDatabase,
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+        InMemoryIndex,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+        InMemoryIndexConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.events import (
+        BlockStored,
+        EventBatch,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+        Message,
+        Pool,
+        PoolConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.resync import (
+        CallableInventorySource,
+        InventoryBlock,
+        PodInventory,
+        ResyncConfig,
+        ResyncManager,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
+        SubscriberManager,
+    )
+    from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+    failures = []
+    n_pods = int(os.environ.get("EVENTS_SMOKE_PODS", "64"))
+    floor = float(os.environ.get("EVENTS_SMOKE_FLOOR_MSGS_S", "200"))
+    window_s = float(os.environ.get("EVENTS_SMOKE_WINDOW_S", "2.0"))
+    block_size = 16
+    run = uuid.uuid4().hex[:8]
+    model = "smoke/model"
+
+    context = zmq.Context()
+    context.set(zmq.MAX_SOCKETS, 4 * n_pods + 64)
+    pods = [f"smoke-{run}-{i}" for i in range(n_pods)]
+    endpoints = {pod: f"inproc://{pod}" for pod in pods}
+    pub = {}
+    for pod in pods:
+        sock = context.socket(zmq.PUB)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.bind(endpoints[pod])
+        pub[pod] = sock
+    seqs = {pod: 0 for pod in pods}
+    tokens = list(range(2 * block_size))
+    payload = EventBatch(
+        ts=0.0,
+        events=[
+            BlockStored(
+                block_hashes=[1, 2],
+                parent_block_hash=None,
+                token_ids=tokens,
+                block_size=block_size,
+            )
+        ],
+    ).encode()
+
+    def publish(pod, body=None, skip=0):
+        seqs[pod] += 1 + skip
+        pub[pod].send_multipart(
+            [
+                f"kv@{pod}@{model}".encode(),
+                struct.pack(">Q", seqs[pod]),
+                body if body is not None else payload,
+            ]
+        )
+
+    index = InMemoryIndex(InMemoryIndexConfig(size=1_000_000))
+    db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=block_size))
+    pool = Pool(index, db, PoolConfig(concurrency=4))
+    pool.start()
+
+    # Ground truth for the resync: each pod owns one private block.
+    truth = {}
+    for i, pod in enumerate(pods):
+        base = 1000 + i
+        truth[pod] = InventoryBlock(
+            block_hashes=[base],
+            token_ids=[(base + j) % 5000 + 1 for j in range(block_size)],
+            block_size=block_size,
+            medium="hbm",
+        )
+    source = CallableInventorySource(
+        lambda pod: PodInventory(
+            pod_identifier=pod, model_name=model, blocks=[truth[pod]]
+        )
+    )
+    resync = ResyncManager(pool, source, ResyncConfig(apply_timeout_s=30))
+    resync.start()
+
+    seen = set()
+    seen_lock = threading.Lock()
+
+    def sink(message):
+        with seen_lock:
+            seen.add(message.pod_identifier)
+        pool.add_task(message)
+
+    manager = SubscriberManager(
+        sink=sink,
+        context=context,
+        pollers=1,
+        poll_interval_ms=10,
+        on_gap=resync.gap_listener,
+    )
+    for pod in pods:
+        manager.ensure_subscriber(pod, endpoints[pod])
+
+    def hist_stats(hist):
+        total = count = 0.0
+        for metric in hist.collect():
+            for sample in metric.samples:
+                if sample.name.endswith("_sum"):
+                    total = sample.value
+                elif sample.name.endswith("_count"):
+                    count = sample.value
+        return total, count
+
+    def labeled_total(counter, **labels):
+        total = 0.0
+        for metric in counter.collect():
+            for sample in metric.samples:
+                if sample.name.endswith("_total") and all(
+                    sample.labels.get(k) == v for k, v in labels.items()
+                ):
+                    total += sample.value
+        return total
+
+    try:
+        # -- join ----------------------------------------------------
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(seen) < n_pods:
+            for pod in pods:
+                if pod not in seen:
+                    publish(pod)
+            time.sleep(0.05)
+        if len(seen) < n_pods:
+            failures.append(
+                f"only {len(seen)}/{n_pods} subscriptions became live"
+            )
+        pool.drain()
+
+        # -- throughput floor + thread ceiling -----------------------
+        _, drained_before = 0.0, None
+        drained_before = hist_stats(METRICS.kvevents_batch_size)[0]
+        threads = sum(
+            1
+            for t in threading.enumerate()
+            if t.name.startswith(("kvtpu-evplane-", "kvtpu-events-"))
+        )
+        ceiling = 1 + 4 + 1  # pollers + pool workers + resync worker
+        if threads > ceiling:
+            failures.append(
+                f"event plane runs {threads} threads for {n_pods} pods "
+                f"(ceiling {ceiling})"
+            )
+        t0 = time.perf_counter()
+        stop = time.perf_counter() + window_s
+        while time.perf_counter() < stop:
+            for pod in pods:
+                publish(pod)
+        pool.drain()
+        elapsed = time.perf_counter() - t0
+        applied = hist_stats(METRICS.kvevents_batch_size)[0] - drained_before
+        rate = applied / elapsed
+        if rate < floor:
+            failures.append(
+                f"apply throughput {rate:.0f} msgs/s below the "
+                f"{floor:.0f} floor"
+            )
+
+        # -- zero cross-pod sheds under a chatty flood ---------------
+        chatty, victims = pods[0], pods[1:]
+        victim_shed_before = sum(
+            labeled_total(METRICS.kvevents_pod_shed, pod=pod)
+            for pod in victims
+        )
+        for _ in range(5000):
+            publish(chatty)
+        pool.drain()
+        victim_shed = (
+            sum(
+                labeled_total(METRICS.kvevents_pod_shed, pod=pod)
+                for pod in victims
+            )
+            - victim_shed_before
+        )
+        if victim_shed:
+            failures.append(
+                f"chatty flood shed {victim_shed:.0f} messages from "
+                "other pods (fairness property violated)"
+            )
+
+        # -- forced gap -> resync ------------------------------------
+        gap_pod = pods[1]
+        # Seed the pod's ground-truth chain live, then lose 5 events.
+        publish(
+            gap_pod,
+            EventBatch(
+                ts=0.0,
+                events=[
+                    BlockStored(
+                        block_hashes=list(truth[gap_pod].block_hashes),
+                        parent_block_hash=None,
+                        token_ids=list(truth[gap_pod].token_ids),
+                        block_size=block_size,
+                        medium="hbm",
+                    )
+                ],
+            ).encode(),
+        )
+        pool.drain()
+        staleness_n_before = hist_stats(METRICS.kvevents_resync_staleness)[1]
+        publish(gap_pod, skip=5)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            stats = resync.stats()
+            if stats["resyncs_ok"] >= 1 and not stats["suspect"]:
+                break
+            time.sleep(0.05)
+        stats = resync.stats()
+        if stats["resyncs_ok"] < 1 or stats["suspect"]:
+            failures.append(f"forced gap did not resync: {stats}")
+        if hist_stats(METRICS.kvevents_resync_staleness)[1] <= (
+            staleness_n_before
+        ):
+            failures.append("resync staleness histogram gained no sample")
+        keys = db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, truth[gap_pod].token_ids, model
+        )
+        found = index.lookup(keys)
+        if set(found) != set(keys) or not all(
+            any(e.pod_identifier == gap_pod for e in entries)
+            for entries in found.values()
+        ):
+            failures.append(
+                "post-resync index does not claim the pod's inventory"
+            )
+
+        # -- publisher restart classified, gaps not inflated ----------
+        restarts_before = labeled_total(
+            METRICS.kvevents_publisher_restarts, pod=gap_pod
+        )
+        gaps_before = labeled_total(METRICS.kvevents_seq_gaps, pod=gap_pod)
+        seqs[gap_pod] = 0  # simulate engine restart: counter resets
+        publish(gap_pod)
+        deadline = time.monotonic() + 30
+        while (
+            time.monotonic() < deadline
+            and labeled_total(
+                METRICS.kvevents_publisher_restarts, pod=gap_pod
+            )
+            == restarts_before
+        ):
+            time.sleep(0.05)
+        if (
+            labeled_total(METRICS.kvevents_publisher_restarts, pod=gap_pod)
+            != restarts_before + 1
+        ):
+            failures.append("publisher restart not detected")
+        if labeled_total(METRICS.kvevents_seq_gaps, pod=gap_pod) != (
+            gaps_before
+        ):
+            failures.append("publisher restart inflated the gap counter")
+    finally:
+        manager.shutdown()
+        resync.close()
+        pool.shutdown()
+        for sock in pub.values():
+            sock.close()
+        context.term()
+
+    if failures:
+        print("EVENTS SMOKE FAILED:", file=sys.stderr)
+        for failure in failures:
+            print(f"  - {failure}", file=sys.stderr)
+        return 1
+    print(
+        f"events smoke ok: {n_pods} pods, {rate:.0f} msgs/s applied, "
+        f"{threads} event-plane threads, gap resynced, restart "
+        "classified",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

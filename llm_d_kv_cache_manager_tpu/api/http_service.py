@@ -50,6 +50,7 @@ def _make_handler(
     admin_token: Optional[str] = None,
     persistence=None,
     recovery_report=None,
+    event_plane_status=None,
 ):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -201,6 +202,14 @@ def _make_handler(
                     health["recovery"] = recovery_report.to_dict()
                 if persistence is not None:
                     health["persistence"] = persistence.status()
+                if event_plane_status is not None:
+                    # Live view: poller fan-in, suspect pods, resync
+                    # outcomes (docs/event-plane.md).
+                    try:
+                        health["event_plane"] = event_plane_status()
+                    except Exception:  # noqa: BLE001 — health must answer
+                        logger.exception("event-plane status failed")
+                        health["event_plane"] = {"error": "unavailable"}
                 self._reply_json(200, health)
             elif path == "/debug/traces":
                 self._debug_traces(query)
@@ -467,6 +476,7 @@ def serve(
     admin_token: Optional[str] = None,
     persistence=None,
     recovery_report=None,
+    event_plane_status=None,
 ) -> http.server.ThreadingHTTPServer:
     """Start the HTTP service on a background thread; returns the server
     (call ``.shutdown()`` to stop).  ``admin_token`` (env:
@@ -474,7 +484,8 @@ def serve(
     accepted from loopback only.  ``persistence`` (a
     ``PersistenceManager``) enables ``POST /admin/snapshot`` and the
     persistence block in ``/healthz``; ``recovery_report`` surfaces the
-    startup recovery outcome there too."""
+    startup recovery outcome there too; ``event_plane_status`` (a
+    zero-arg callable) adds the event-plane block."""
     server = http.server.ThreadingHTTPServer(
         (host, port),
         _make_handler(
@@ -482,6 +493,7 @@ def serve(
             admin_token=admin_token,
             persistence=persistence,
             recovery_report=recovery_report,
+            event_plane_status=event_plane_status,
         ),
     )
     thread = threading.Thread(
@@ -593,10 +605,40 @@ def main() -> None:  # pragma: no cover - CLI entry
             apply_batch_size=int(
                 os.environ.get("KVEVENTS_APPLY_BATCH", "32")
             ),
+            # Per-pod flow control (docs/event-plane.md): in-flight
+            # budget per pod, fairness-aware shedding.  0/unset budget
+            # -> whole-shard depth (budget engages only at overflow);
+            # the 0 case must map to None here or PoolConfig would
+            # clamp it to a 1-message budget.
+            pod_budget=(
+                int(os.environ.get("KVEVENTS_POD_BUDGET") or 0) or None
+            ),
+            per_pod_flow_control=os.environ.get(
+                "KVEVENTS_POD_FLOW", "1"
+            ).lower()
+            not in ("0", "false", "no"),
         ),
         journal=persistence.journal if persistence else None,
     )
     pool.start()
+    # Gap-driven anti-entropy (docs/event-plane.md): a wire-level seq
+    # gap marks the pod suspect and triggers purge + inventory
+    # re-apply.  Without a fleet inventory surface the default "purge"
+    # mode uses the empty source (purge-only repair); "off" disables.
+    resync = None
+    if os.environ.get("KVEVENTS_GAP_RESYNC", "purge").lower() not in (
+        "off",
+        "0",
+        "false",
+        "no",
+    ):
+        from llm_d_kv_cache_manager_tpu.kvevents.resync import (
+            EmptyInventorySource,
+            ResyncManager,
+        )
+
+        resync = ResyncManager(pool, EmptyInventorySource())
+        resync.start()
     # Two event-ingestion modes (reference online example supports both):
     # - POD_DISCOVERY=true: watch the k8s API and dial out to each serving
     #   pod's ZMQ socket (needs the pod list/watch RBAC grant);
@@ -606,7 +648,11 @@ def main() -> None:  # pragma: no cover - CLI entry
         "true",
         "yes",
     )
-    manager = SubscriberManager(sink=pool.add_task, bind=not discover)
+    manager = SubscriberManager(
+        sink=pool.add_task,
+        bind=not discover,
+        on_gap=resync.gap_listener if resync else None,
+    )
     reconciler = None
     if discover:
         from llm_d_kv_cache_manager_tpu.kvevents.pod_reconciler import (
@@ -642,12 +688,23 @@ def main() -> None:  # pragma: no cover - CLI entry
     stop_beat = start_metrics_logging(
         float(os.environ.get("METRICS_LOGGING_INTERVAL", "60"))
     )
+
+    def event_plane_status() -> dict:
+        status = {
+            "pollers": manager.poller_count(),
+            "subscriptions": len(manager.active_pods()),
+        }
+        if resync is not None:
+            status["resync"] = resync.stats()
+        return status
+
     server = serve(
         indexer,
         port=int(os.environ.get("HTTP_PORT", "8080")),
         admin_token=os.environ.get("ADMIN_TOKEN"),
         persistence=persistence,
         recovery_report=recovery_report,
+        event_plane_status=event_plane_status,
     )
     try:
         threading.Event().wait()
@@ -661,6 +718,8 @@ def main() -> None:  # pragma: no cover - CLI entry
         if reconciler is not None:
             reconciler.stop()
         manager.shutdown()
+        if resync is not None:
+            resync.close()
         pool.shutdown()
         if persistence is not None:
             # Parting snapshot: the next start recovers warm even if

@@ -10,6 +10,21 @@ from llm_d_kv_cache_manager_tpu.kvevents.pool import (  # noqa: F401
     Message,
     Pool,
     PoolConfig,
+    ResyncJob,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.poller import (  # noqa: F401
+    ChannelConfig,
+    PollerPool,
+    PollerPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.resync import (  # noqa: F401
+    CallableInventorySource,
+    EmptyInventorySource,
+    InventoryBlock,
+    InventorySource,
+    PodInventory,
+    ResyncConfig,
+    ResyncManager,
 )
 from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (  # noqa: F401
     SubscriberManager,

@@ -24,24 +24,44 @@ post-apply path: every successful ``index.add``/``evict`` is appended as
 an applied-operation record, which is what makes warm indexer restarts
 possible (see docs/persistence.md).
 
-Each shard queue is *bounded* (``PoolConfig.max_queue_depth``, matching the
-reference's bounded per-shard workqueues, pool.go:134-173).  When a shard
-fills — an event storm, or a stuck index backend wedging one worker — the
-pool drops the *oldest* queued message from that shard to admit the new
-one, and counts it in ``kvtpu_kvevents_dropped_total{reason="queue_full"}``.
-Drop-oldest is the right policy for an ephemeral index: the newest events
-describe the pod's current cache contents; stale ones were about to be
-superseded anyway, and per-pod relative ordering of the surviving messages
-is preserved.
+**Per-pod flow control** (docs/event-plane.md): each shard queue keeps a
+FIFO *lane per pod* instead of one global FIFO.  Workers drain lanes
+round-robin (one message per pod per rotation), so a chatty pod shares
+the batch with everyone else instead of monopolizing it.  Shedding is
+budgeted per pod: a pod whose lane reaches ``PoolConfig.pod_budget``
+sheds its OWN oldest message, and when the whole shard is full the
+victim is the pod with the longest lane — which is always at or over
+its fair share (``max_queue_depth // active pods``), so **a pod under
+its effective budget** (``min(pod_budget, max_queue_depth // active
+pods)``) **is never shed** — the fairness property the event_storm
+bench and the property tests pin.  Within one pod, drop-oldest is
+unchanged: the newest events describe the pod's current cache contents;
+stale ones were about to be superseded anyway, and per-pod relative
+ordering of the survivors is preserved.  Sheds are counted both in
+``kvtpu_kvevents_dropped_total{reason}`` (``queue_full`` — whole-shard
+overflow, ``pod_budget`` — over-budget pod, ``shutdown``) and per pod
+in ``kvtpu_kvevents_pod_shed_total{pod=...}``; per-pod backlog rides
+the ``kvtpu_kvevents_pod_backlog{pod=...}`` gauge.
+``PoolConfig.per_pod_flow_control=False`` restores the legacy global
+FIFO + drop-oldest (the bench A/B baseline).
+
+**Resync commands**: the anti-entropy path (``kvevents/resync.py``)
+repairs a pod whose event stream gapped by enqueueing a
+:class:`ResyncJob` through :meth:`Pool.enqueue_resync`.  The job rides
+the pod's normal shard lane — so it is ordered against that pod's live
+events — and is applied by the worker as *purge, then re-apply the
+inventory snapshot* through the same batched-apply surface live events
+use.  Resync commands are never shed (shedding one would strand the
+pod suspect forever); a shutdown drop reports failure to the waiter.
 """
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
-from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, PodEntry
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
@@ -77,6 +97,10 @@ logger = get_logger("kvevents.pool")
 # catch a future inversion (e.g. a drain that applies under _lock).
 # kvlint: lock-order: Pool._lock < LRUCache._lock
 lockorder.declare_order("Pool._lock", "LRUCache._lock")
+# Shard-queue lanes are a leaf: put/get hold it only for deque surgery;
+# metrics, trace bookkeeping, and index applies all happen outside.
+# kvlint: lock-order: Pool._lock < ShardQueue._lock
+lockorder.declare_order("Pool._lock", "ShardQueue._lock")
 
 # TPU pods' on-chip tier; events without an explicit medium default here
 # (GPU-era fleets default to "gpu" — both score 1.0 by default).
@@ -91,6 +115,50 @@ def fnv1a_32(data: bytes) -> int:
     for byte in data:
         h = ((h ^ byte) * _FNV32_PRIME) & 0xFFFFFFFF
     return h
+
+
+@dataclass
+class ResyncJob:
+    """An anti-entropy repair for one pod, applied in shard-lane order.
+
+    ``events`` are decoded ``BlockStored`` inventory records in
+    parent-chain order (``kvevents/resync.py`` builds them from an
+    ``InventorySource`` snapshot).  The worker purges the pod's index
+    entries, re-applies the inventory through the batched-apply
+    surface, then calls ``on_done(job, ok, purged, detail)`` exactly
+    once — also on shutdown-drop, so a waiter never hangs.
+    """
+
+    pod_identifier: str
+    model_name: str
+    events: List[object] = field(default_factory=list)
+    # perf_counter timestamp when the pod was first marked suspect;
+    # done-time minus this is the index-staleness window the bench and
+    # the resync histogram report.
+    suspect_since: float = 0.0
+    on_done: Optional[Callable[["ResyncJob", bool, int, str], None]] = None
+    purged: int = 0
+    # First _finish wins: a job drained by a worker during shutdown and
+    # then swept by the orphan pass must report exactly once.
+    _done_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
+    _done: bool = field(default=False, repr=False)  # guarded-by: _done_lock
+
+    def _finish(self, ok: bool, purged: int, detail: str) -> None:
+        with self._done_lock:
+            if self._done:
+                return
+            self._done = True
+        self.purged = purged
+        if self.on_done is not None:
+            try:
+                self.on_done(self, ok, purged, detail)
+            except Exception:  # noqa: BLE001 — waiter bugs stay theirs
+                logger.exception(
+                    "resync on_done callback failed for pod %s",
+                    self.pod_identifier,
+                )
 
 
 @dataclass
@@ -110,6 +178,10 @@ class Message:
     # explicit propagation across the pool's thread boundary.
     trace: Optional[Trace] = None
     enqueued_at: float = 0.0
+    # Anti-entropy command (see module docstring): when set the worker
+    # purges + re-applies instead of decoding ``payload``; such command
+    # messages are never shed by flow control.
+    resync: Optional[ResyncJob] = None
 
 
 @dataclass
@@ -125,6 +197,216 @@ class PoolConfig:
     # an idle stream degenerates to batch size 1 with no added
     # latency.  Observed in the kvtpu_kvevents_batch_size histogram.
     apply_batch_size: int = 32
+    # Per-pod in-flight budget: a pod with this many queued messages in
+    # its shard lane sheds its OWN oldest to admit a new one, whatever
+    # the rest of the shard is doing.  None -> max_queue_depth (the
+    # budget then only engages via whole-shard overflow, where the
+    # longest lane is shed).  See module docstring for the fairness
+    # property.
+    pod_budget: Optional[int] = None
+    # False restores the legacy single global FIFO per shard with
+    # drop-oldest shedding (no lanes, no budget) — the event_storm
+    # bench's A/B baseline and an escape hatch.
+    per_pod_flow_control: bool = True
+
+    def effective_pod_budget(self) -> int:
+        if self.pod_budget is None:
+            return self.max_queue_depth
+        return max(1, self.pod_budget)
+
+
+class _ShardQueue:
+    """Bounded per-shard message store with per-pod FIFO lanes.
+
+    Replaces ``queue.Queue``: same blocking get / task accounting /
+    close semantics, plus lane-aware shedding and round-robin drain
+    (module docstring).  All methods are thread-safe; the lock is a
+    leaf (deque surgery only — metrics and trace finishing happen in
+    the caller, outside the lock).
+    """
+
+    def __init__(
+        self, max_depth: int, pod_budget: int, per_pod: bool
+    ) -> None:
+        self._max_depth = max_depth
+        self._pod_budget = pod_budget
+        self._per_pod = per_pod
+        # One Condition serves as both the mutex and the wake channel
+        # (workers wait for work, join() waits for quiescence — the
+        # while-loops disambiguate).  Tracked as the Condition itself,
+        # the same shape as StagingBudget._cond: tracking the inner
+        # lock would trip the watchdog on Condition's ownership probe.
+        # kvlint: lock-order: Pool._lock < ShardQueue._lock
+        self._lock = lockorder.tracked(
+            threading.Condition(), "ShardQueue._lock"
+        )
+        # Lane order IS the drain rotation: the front lane serves one
+        # message, then rotates to the back.
+        self._lanes: "OrderedDict[str, Deque[Message]]" = (
+            OrderedDict()
+        )  # guarded-by: _lock
+        self._regular: Dict[str, int] = {}  # guarded-by: _lock
+        self._size = 0  # guarded-by: _lock  (regular messages only)
+        self._unfinished = 0  # guarded-by: _lock  (incl. commands)
+        self._closed = False  # guarded-by: _lock
+
+    def _lane_key(self, message: Message) -> str:
+        return message.pod_identifier if self._per_pod else ""
+
+    def _shed_from_locked(
+        self, key: str, reason: str, shed: List[Tuple[Message, str]]
+    ) -> None:
+        """Pop the oldest REGULAR message from a lane (commands are
+        never shed); caller holds the lock and guarantees one exists."""
+        lane = self._lanes[key]
+        stash: List[Message] = []
+        victim: Optional[Message] = None
+        while lane:
+            candidate = lane.popleft()
+            if candidate.resync is None:
+                victim = candidate
+                break
+            stash.append(candidate)
+        for command in reversed(stash):
+            lane.appendleft(command)
+        if victim is None:  # pragma: no cover — guarded by _regular
+            return
+        self._regular[key] -= 1
+        self._size -= 1
+        self._unfinished -= 1
+        if not lane:
+            del self._lanes[key]
+            del self._regular[key]
+        shed.append((victim, reason))
+
+    def put(self, message: Message) -> Tuple[List[Tuple[Message, str]], int]:
+        """Admit a message, shedding per the flow-control policy.
+
+        Returns ``(shed, lane_depth)``: messages displaced (with their
+        shed reason) for the caller to count/finish outside the lock,
+        and the admitting pod's lane depth after the put (-1 when the
+        message itself was rejected at shutdown).
+        """
+        key = self._lane_key(message)
+        is_command = message.resync is not None
+        shed: List[Tuple[Message, str]] = []
+        with self._lock:
+            if self._closed:
+                return [(message, "shutdown")], -1
+            lane = self._lanes.get(key)
+            if not is_command:
+                # Overflow outranks the budget label: at whole-shard
+                # capacity the drop IS a queue_full event (the reason
+                # dashboards have always alerted on), whoever the
+                # victim — the longest lane, which is at or above its
+                # effective budget by construction.  The pod_budget
+                # reason is reserved for a pod hitting its own budget
+                # while the shard still has room (otherwise legacy
+                # single-lane mode, whose budget equals the depth,
+                # would relabel every overflow drop).
+                if self._size >= self._max_depth:
+                    victim_key = max(
+                        self._regular, key=self._regular.__getitem__
+                    )
+                    self._shed_from_locked(victim_key, "queue_full", shed)
+                elif (
+                    lane is not None
+                    and self._regular.get(key, 0) >= self._pod_budget
+                ):
+                    self._shed_from_locked(key, "pod_budget", shed)
+                lane = self._lanes.get(key)
+            if lane is None:
+                lane = deque()
+                self._lanes[key] = lane
+                self._regular[key] = 0
+            lane.append(message)
+            if not is_command:
+                self._regular[key] += 1
+                self._size += 1
+            self._unfinished += 1
+            depth = self._regular[key]
+            self._lock.notify_all()
+        return shed, depth
+
+    def get_batch(
+        self, limit: int
+    ) -> Tuple[List[Message], bool, Dict[str, int]]:
+        """Block for work; drain up to ``limit`` messages round-robin
+        across lanes.  Returns ``(batch, closed, depths)`` where
+        ``closed`` means the queue is closed AND fully drained, and
+        ``depths`` is the post-drain regular backlog of every lane the
+        batch touched (for the backlog gauge)."""
+        with self._lock:
+            while not self._lanes and not self._closed:
+                self._lock.wait()
+            if not self._lanes:
+                return [], True, {}
+            batch: List[Message] = []
+            depths: Dict[str, int] = {}
+            while self._lanes and len(batch) < limit:
+                key, lane = next(iter(self._lanes.items()))
+                message = lane.popleft()
+                batch.append(message)
+                if message.resync is None:
+                    self._regular[key] -= 1
+                    self._size -= 1
+                depths[key] = self._regular.get(key, 0)
+                if lane:
+                    self._lanes.move_to_end(key)
+                else:
+                    del self._lanes[key]
+                    del self._regular[key]
+            return batch, False, depths
+
+    def task_done(self, count: int) -> None:
+        if count <= 0:
+            return
+        with self._lock:
+            self._unfinished -= count
+            if self._unfinished <= 0:
+                self._lock.notify_all()
+
+    def join(self) -> None:
+        with self._lock:
+            while self._unfinished > 0:
+                self._lock.wait()
+
+    def close(self) -> List[Tuple[Message, str]]:
+        """Mark closed and wake workers; queued messages still drain.
+        Returns queued resync commands so the pool can fail their
+        waiters if its workers are already gone."""
+        with self._lock:
+            if self._closed:
+                return []
+            self._closed = True
+            self._lock.notify_all()
+            return [
+                message
+                for lane in self._lanes.values()
+                for message in lane
+                if message.resync is not None
+            ]
+
+    def qsize(self) -> int:
+        with self._lock:
+            return self._size
+
+    def snapshot(self) -> List[Message]:
+        """Queued messages in drain (round-robin) order — tests only."""
+        with self._lock:
+            lanes = [list(lane) for lane in self._lanes.values()]
+        out: List[Message] = []
+        index = 0
+        while any(index < len(lane) for lane in lanes):
+            for lane in lanes:
+                if index < len(lane):
+                    out.append(lane[index])
+            index += 1
+        return out
+
+    def lane_depths(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._regular)
 
 
 class _BatchApplier:
@@ -223,14 +505,15 @@ class _BatchApplier:
 
 
 class Pool:
-    """N worker threads, each draining its own FIFO queue.
+    """N worker threads, each draining its own lane-structured queue.
 
     Each wake-up drains up to ``PoolConfig.apply_batch_size`` queued
-    messages, decodes them together, and applies them through a
-    :class:`_BatchApplier` so admissions group per index shard before
-    any lock is taken.  Per-message traces, poison-pill handling, and
-    per-pod ordering are unchanged from the one-message-at-a-time
-    path; batch sizes land in ``kvtpu_kvevents_batch_size``.
+    messages (round-robin across the shard's pod lanes), decodes them
+    together, and applies them through a :class:`_BatchApplier` so
+    admissions group per index shard before any lock is taken.
+    Per-message traces, poison-pill handling, and per-pod ordering are
+    unchanged from the one-message-at-a-time path; batch sizes land in
+    ``kvtpu_kvevents_batch_size``.
     """
 
     def __init__(
@@ -253,8 +536,12 @@ class Pool:
         self._journal = journal
         if self.config.max_queue_depth <= 0:
             raise ValueError("pool max_queue_depth must be positive")
-        self._queues: List["queue.Queue[Optional[Message]]"] = [
-            queue.Queue(maxsize=self.config.max_queue_depth)
+        self._queues: List[_ShardQueue] = [
+            _ShardQueue(
+                self.config.max_queue_depth,
+                self.config.effective_pod_budget(),
+                self.config.per_pod_flow_control,
+            )
             for _ in range(self.config.concurrency)
         ]
         self._threads: List[threading.Thread] = []  # guarded-by: _lock
@@ -280,12 +567,19 @@ class Pool:
         with self._lock:
             if not self._started:
                 return
+            orphaned: List[Message] = []
             for q in self._queues:
-                self._put_sentinel(q)
-            for thread in self._threads:
-                thread.join(timeout=10)
+                orphaned.extend(q.close())
+            threads = list(self._threads)
             self._threads.clear()
             self._started = False
+        for thread in threads:
+            thread.join(timeout=10)
+        # Workers that exited without draining (or never existed) must
+        # not leave resync waiters hanging.
+        for message in orphaned:
+            if message.resync is not None:
+                message.resync._finish(False, 0, "pool shutdown")
 
     def drain(self) -> None:
         """Block until every queued message has been processed (tests)."""
@@ -299,6 +593,12 @@ class Pool:
         if dropped.trace is not None:
             dropped.trace.set_error(f"dropped: {reason}")
             dropped.trace.finish("error")
+        if dropped.resync is not None:
+            dropped.resync._finish(False, 0, f"dropped: {reason}")
+
+    def _shard_for(self, pod_identifier: str) -> _ShardQueue:
+        shard = fnv1a_32(pod_identifier.encode()) % len(self._queues)
+        return self._queues[shard]
 
     def add_task(self, message: Message) -> None:
         if message.trace is None:
@@ -310,87 +610,54 @@ class Pool:
                 message.trace = tr
         if message.trace is not None:
             message.enqueued_at = time.perf_counter()
-        shard = fnv1a_32(message.pod_identifier.encode()) % len(self._queues)
-        q = self._queues[shard]
-        while True:
-            try:
-                q.put_nowait(message)
-                return
-            except queue.Full:
-                pass
-            # Shed the oldest queued message from this shard to admit the
-            # new one (see module docstring for why drop-oldest).
-            try:
-                dropped = q.get_nowait()
-            except queue.Empty:
-                continue  # a worker drained it between put and get; retry
-            q.task_done()
-            if dropped is None:
-                # Raced with shutdown: the popped item was the stop
-                # sentinel.  Drop the NEW message instead and restore the
-                # sentinel so the worker still exits.
-                try:
-                    q.put_nowait(None)
-                except queue.Full:
-                    # Never block here; the thread join in shutdown()
-                    # has a timeout, so a lost sentinel only delays it.
-                    logger.warning(
-                        "shard %d full while restoring the shutdown "
-                        "sentinel; worker exit may be delayed",
-                        shard,
-                    )
-                METRICS.kvevents_dropped.labels(reason="shutdown").inc()
-                self._finish_dropped(message, "shutdown")
-                return
-            METRICS.kvevents_dropped.labels(reason="queue_full").inc()
-            self._finish_dropped(dropped, "queue_full")
+        q = self._shard_for(message.pod_identifier)
+        shed, depth = q.put(message)
+        # Metrics + trace finishing OUTSIDE the shard lock.
+        for dropped, reason in shed:
+            METRICS.kvevents_dropped.labels(reason=reason).inc()
+            METRICS.kvevents_pod_shed.labels(
+                pod=dropped.pod_identifier
+            ).inc()
+            self._finish_dropped(dropped, reason)
             logger.debug(
-                "event shard %d full (depth %d); dropped oldest message "
-                "from pod %s",
-                shard,
-                self.config.max_queue_depth,
+                "event shard shed a message from pod %s (%s)",
                 dropped.pod_identifier,
+                reason,
             )
+        if depth >= 0:
+            METRICS.kvevents_pod_backlog.labels(
+                pod=message.pod_identifier
+            ).set(depth)
 
-    @classmethod
-    def _put_sentinel(cls, q: "queue.Queue[Optional[Message]]") -> None:
-        """Enqueue the stop sentinel, shedding old messages if full."""
-        while True:
-            try:
-                q.put_nowait(None)
-                return
-            except queue.Full:
-                try:
-                    shed = q.get_nowait()
-                    q.task_done()
-                    METRICS.kvevents_dropped.labels(reason="shutdown").inc()
-                    if shed is not None:
-                        cls._finish_dropped(shed, "shutdown")
-                except queue.Empty:
-                    pass
+    def enqueue_resync(self, job: ResyncJob, trace_: Optional[Trace] = None):
+        """Queue an anti-entropy repair in the pod's shard lane (so it
+        is ordered against the pod's live events)."""
+        message = Message(
+            topic=f"resync@{job.pod_identifier}",
+            payload=b"",
+            pod_identifier=job.pod_identifier,
+            model_name=job.model_name,
+            trace=trace_,
+            resync=job,
+        )
+        if message.trace is not None:
+            message.enqueued_at = time.perf_counter()
+        shed, _depth = self._shard_for(job.pod_identifier).put(message)
+        for dropped, reason in shed:
+            # Only "shutdown" can reject a command message.
+            METRICS.kvevents_dropped.labels(reason=reason).inc()
+            self._finish_dropped(dropped, reason)
 
     def _worker(self, worker_index: int) -> None:
         q = self._queues[worker_index]
         batch_limit = max(1, self.config.apply_batch_size)
         while True:
-            first = q.get()
-            if first is None:
-                q.task_done()
+            batch, closed, depths = q.get_batch(batch_limit)
+            if closed:
                 return
-            batch: List[Message] = [first]
-            saw_sentinel = False
-            # Opportunistic drain: under a backlog the worker grabs up
-            # to the batch limit without blocking; an idle stream
-            # processes single messages with no added latency.
-            while len(batch) < batch_limit:
-                try:
-                    extra = q.get_nowait()
-                except queue.Empty:
-                    break
-                if extra is None:
-                    saw_sentinel = True
-                    break
-                batch.append(extra)
+            for pod, depth in depths.items():
+                if pod:
+                    METRICS.kvevents_pod_backlog.labels(pod=pod).set(depth)
             try:
                 self._process_batch(batch, worker_index)
             except Exception:
@@ -408,12 +675,7 @@ class Pool:
                 # task_done only after the batch (including the
                 # deferred-add flush) has fully applied: drain() must
                 # imply visibility.
-                for _ in batch:
-                    q.task_done()
-                if saw_sentinel:
-                    q.task_done()
-            if saw_sentinel:
-                return
+                q.task_done(len(batch))
 
     def _process_batch(
         self, batch: List[Message], worker_index: int
@@ -430,6 +692,9 @@ class Pool:
                 tr.add_completed("kvevents.queue_wait", message.enqueued_at)
                 if message.seq_gap:
                     tr.set_attr("seq_gap", message.seq_gap)
+            if message.resync is not None:
+                decoded.append(None)
+                continue
             try:
                 with use_trace(tr):
                     decoded.append(self._decode_message(message))
@@ -449,6 +714,12 @@ class Pool:
         pending_traces: List[Trace] = []
         for message, events in zip(batch, decoded):
             tr = message.trace
+            if message.resync is not None:
+                # Barrier like evictions: the purge must not reorder
+                # ahead of admissions digested earlier in this batch.
+                applier.flush()
+                self._apply_resync(message, worker_index)
+                continue
             if events is None:
                 if tr is not None:
                     # Poison pill (error already set) or decode crash
@@ -483,6 +754,39 @@ class Pool:
         # landed, so "ok" — finish() is idempotent, first call wins.
         for tr in pending_traces:
             tr.finish()
+
+    def _apply_resync(self, message: Message, worker_index: int) -> None:
+        """Purge + re-apply one pod's inventory snapshot, atomically
+        with respect to this worker (the pod's only event applier)."""
+        job = message.resync
+        assert job is not None
+        tr = message.trace
+        try:
+            with use_trace(tr):
+                with obs_span("kvevents.resync.apply") as s:
+                    purged = self._index.purge_pod(job.pod_identifier)
+                    applier = _BatchApplier(self._index, self._journal)
+                    applied = 0
+                    for event in job.events:
+                        self._digest(message, event, applier)
+                        applied += 1
+                    applier.flush()
+                    s.set_attr("purged", purged)
+                    s.set_attr("inventory_events", applied)
+        except Exception as exc:
+            logger.exception(
+                "event worker %d failed resyncing pod %s",
+                worker_index,
+                job.pod_identifier,
+            )
+            if tr is not None:
+                tr.set_error(f"resync apply failed: {exc!r}")
+                tr.finish("error")
+            job._finish(False, 0, f"apply failed: {exc!r}")
+            return
+        if tr is not None:
+            tr.finish()
+        job._finish(True, purged, "ok")
 
     def _decode_message(self, message: Message) -> Optional[EventBatch]:
         with obs_span("kvevents.decode") as s:

@@ -9,6 +9,7 @@ examples/helper/publisher.go:57-84).  Message = 3 parts:
 from __future__ import annotations
 
 import struct
+import threading
 import time
 from typing import Optional
 
@@ -16,6 +17,7 @@ import zmq
 
 from llm_d_kv_cache_manager_tpu.kvevents.events import EventBatch
 from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import TOPIC_PREFIX
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 
 
 class Publisher:
@@ -36,7 +38,13 @@ class Publisher:
             self._socket.bind(endpoint)
         else:
             self._socket.connect(endpoint)
-        self._seq = 0
+        # Seq assignment + send must be one atomic step: two threads
+        # interleaving `_seq += 1` with their sends would publish seqs
+        # out of order (or duplicated), which the subscriber-side
+        # tracker reads as gaps/restarts that never happened.  Leaf
+        # lock — nothing else is acquired under it.
+        self._lock = lockorder.tracked(threading.Lock(), "Publisher._lock")
+        self._seq = 0  # guarded-by: _lock
 
     @property
     def topic(self) -> str:
@@ -53,17 +61,35 @@ class Publisher:
         return int(self.endpoint.rsplit(":", 1)[1])
 
     def publish(self, *events) -> int:
-        """Publish events as one batch; returns the sequence number used."""
+        """Publish events as one batch; returns the sequence number used.
+
+        Thread-safe: concurrent publishers (fleet simulators drive one
+        Publisher from several threads) get strictly increasing seqs
+        with sends in seq order."""
         batch = EventBatch(ts=time.time(), events=list(events))
-        self._seq += 1
-        self._socket.send_multipart(
-            [
-                self.topic.encode(),
-                struct.pack(">Q", self._seq),
-                batch.encode(),
-            ]
-        )
-        return self._seq
+        payload = batch.encode()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._socket.send_multipart(
+                [
+                    self.topic.encode(),
+                    struct.pack(">Q", seq),
+                    payload,
+                ]
+            )
+        return seq
+
+    def advance_seq(self, count: int = 1) -> int:
+        """Skip ``count`` sequence numbers WITHOUT sending — a test/bench
+        hook that makes the next publish look like ``count`` lost events
+        (forces a subscriber-side gap deterministically)."""
+        with self._lock:
+            self._seq += count
+            return self._seq
 
     def close(self) -> None:
-        self._socket.close()
+        # Same lock as publish(): closing mid-send would raise
+        # zmq.ZMQError in whichever simulator thread held the socket.
+        with self._lock:
+            self._socket.close()

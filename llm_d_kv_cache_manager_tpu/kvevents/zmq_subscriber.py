@@ -1,19 +1,32 @@
-"""ZMQ SUB subscriber for one engine pod's KV-event stream.
+"""KV-event wire format: topic parsing, seq tracking, message demux.
 
 Wire format (reference: pkg/kvevents/zmq_subscriber.go:135-155, matching
 vLLM's event publisher): 3-part messages ``[topic, seq, payload]`` where
 ``topic = "kv@<pod-id>@<model>"``, ``seq`` is a big-endian uint64, and
 ``payload`` is a msgpack ``EventBatch``.
 
-Lifecycle: a dedicated thread polls with a short timeout so cancellation is
-responsive; socket errors tear the socket down and reconnect after a
-backoff.  Subscribers tolerate absent publishers (ZMQ connects lazily), so
-the fleet can be simulated — or slow to start — without errors.
+This module owns the *demultiplexing* half of the event plane — shared
+by the consolidated poller (``kvevents/poller.py``, the production
+subscription path: a fixed pool of poller threads multiplexing many SUB
+sockets) and by the legacy one-thread-per-pod :class:`ZMQSubscriber`
+kept below as the bench baseline.
 
-Sequence numbers are parsed and surfaced for gap detection.  The reference
-leaves them unused (zmq_subscriber.go:143, a noted improvement
-opportunity); here a gap increments a counter and logs, giving operators a
-signal that events were lost and scores may be stale until re-store.
+Sequence numbers are parsed per topic (``TopicSeqTracker``) and
+classified three ways:
+
+* ``seq == last + 1`` (or first sighting) — in order;
+* ``seq > last + 1`` — a **gap**: ``seq - last - 1`` events were lost;
+  counted in ``kvtpu_kvevents_seq_gaps_total{pod=...}`` and surfaced to
+  an optional ``on_gap`` callback so the anti-entropy resync path
+  (``kvevents/resync.py``) can mark the pod suspect instead of silently
+  serving stale scores;
+* ``seq < last`` — a **publisher restart** (the engine restarted and
+  its counter reset to 1): the watermark resets to the new seq, the
+  restart is counted in ``kvtpu_kvevents_publisher_restarts_total`` and
+  it is NOT folded into the gap counter — a restarted counter would
+  otherwise inflate gaps by ~``last`` on every engine restart.
+  ``seq == last`` is a duplicate delivery: dropped from accounting
+  entirely (watermark unchanged, no gap, no restart).
 """
 
 from __future__ import annotations
@@ -21,7 +34,7 @@ from __future__ import annotations
 import struct
 import threading
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 import zmq
 
@@ -34,6 +47,37 @@ logger = get_logger("kvevents.zmq")
 TOPIC_PREFIX = "kv@"
 POLL_INTERVAL_MS = 250
 RECONNECT_BACKOFF_SECONDS = 5.0
+
+# on_gap(pod_identifier, topic, events_lost) — called inline on the
+# polling thread; implementations must be fast and non-blocking (the
+# resync manager's mark_suspect only flips a set entry + notifies).
+GapListener = Callable[[str, str, int], None]
+
+
+def topic_filter_bytes(
+    topic_filter: Optional[str], pod_identifier: str
+) -> bytes:
+    """The SUBSCRIBE prefix for one pod's channel: an explicit filter
+    verbatim ("" = everything), else scoped to ``kv@<pod>@``."""
+    if topic_filter is not None:
+        return topic_filter.encode()
+    return f"{TOPIC_PREFIX}{pod_identifier}@".encode()
+
+
+def open_sub_socket(
+    context: zmq.Context, endpoint: str, filter_: bytes, bind: bool
+) -> zmq.Socket:
+    """One pod's SUB socket, configured identically for the
+    consolidated poller and the legacy per-pod subscriber (the bench
+    A/Bs the two paths — their socket setup must never drift)."""
+    sock = context.socket(zmq.SUB)
+    sock.setsockopt(zmq.LINGER, 0)
+    sock.setsockopt(zmq.SUBSCRIBE, filter_)
+    if bind:
+        sock.bind(endpoint)
+    else:
+        sock.connect(endpoint)
+    return sock
 
 
 def parse_topic(topic: str) -> Optional[tuple]:
@@ -51,6 +95,141 @@ def parse_topic(topic: str) -> Optional[tuple]:
 
 
 @dataclass
+class SeqObservation:
+    """Classification of one (topic, seq) sighting."""
+
+    gap: int = 0
+    restarted: bool = False
+    duplicate: bool = False
+
+
+class TopicSeqTracker:
+    """Per-topic sequence watermarks for one pod's event stream.
+
+    NOT thread-safe by design: a tracker is owned by whichever single
+    thread polls its pod's socket (one poller thread per socket in the
+    consolidated pool; the dedicated thread in the legacy subscriber).
+    Sequence numbers are independent per topic — model/LoRA streams
+    from one pod each number from their own counter.
+    """
+
+    __slots__ = ("_last_seq_by_topic", "gap_count", "restart_count")
+
+    def __init__(self) -> None:
+        self._last_seq_by_topic: Dict[str, int] = {}
+        self.gap_count = 0
+        self.restart_count = 0
+
+    def observe(self, topic: str, seq: int) -> SeqObservation:
+        last = self._last_seq_by_topic.get(topic)
+        if last is None or seq == last + 1:
+            self._last_seq_by_topic[topic] = seq
+            return SeqObservation()
+        if seq > last + 1:
+            gap = seq - last - 1
+            self.gap_count += gap
+            self._last_seq_by_topic[topic] = seq
+            return SeqObservation(gap=gap)
+        if seq == last:
+            # Duplicate delivery (PUB fan-in quirk): not a restart, not
+            # a gap — and the watermark must not move.
+            return SeqObservation(duplicate=True)
+        # seq < last: the publisher restarted and its counter reset.
+        # Reset the watermark so the NEXT message is judged against the
+        # new counter, and keep the gap metric honest.
+        self.restart_count += 1
+        self._last_seq_by_topic[topic] = seq
+        return SeqObservation(restarted=True)
+
+
+def parse_event_message(
+    parts,
+    endpoint: str,
+    pod_identifier: str,
+    tracker: Optional[TopicSeqTracker] = None,
+    on_gap: Optional[GapListener] = None,
+) -> Optional[Message]:
+    """Decode one ``[topic, seq, payload]`` multipart into a Message.
+
+    Shared by the consolidated poller and the legacy subscriber so both
+    paths classify gaps/restarts identically.  Dropped frames are event
+    loss (stale scores for that pod until re-store), so every drop path
+    logs at warning with enough context to find the misbehaving
+    publisher.  Returns None for malformed frames and duplicate seqs.
+    """
+    if len(parts) != 3:
+        logger.warning(
+            "dropping %d-part message from %s (want [topic, seq, payload])",
+            len(parts),
+            endpoint,
+        )
+        return None
+    topic_raw, seq_raw, payload = parts
+    try:
+        topic = topic_raw.decode()
+    except UnicodeDecodeError:
+        logger.warning(
+            "dropping message with undecodable topic from %s", endpoint
+        )
+        return None
+    parsed = parse_topic(topic)
+    if parsed is None:
+        logger.warning(
+            "dropping message with malformed topic %r from %s",
+            topic,
+            endpoint,
+        )
+        return None
+    pod_id, model = parsed
+
+    seq = 0
+    gap = 0
+    if len(seq_raw) == 8:
+        seq = struct.unpack(">Q", seq_raw)[0]
+        if tracker is not None:
+            observed = tracker.observe(topic, seq)
+            if observed.duplicate:
+                trace(logger, "duplicate seq %d on %s; dropping", seq, topic)
+                return None
+            if observed.restarted:
+                METRICS.kvevents_publisher_restarts.labels(pod=pod_id).inc()
+                logger.info(
+                    "publisher restart on %s: counter reset to %d "
+                    "(watermark reset, not counted as a gap)",
+                    topic,
+                    seq,
+                )
+            elif observed.gap:
+                gap = observed.gap
+                METRICS.kvevents_seq_gaps.labels(pod=pod_id).inc(gap)
+                logger.warning(
+                    "sequence gap on %s: -> %d (%d events lost)",
+                    topic,
+                    seq,
+                    gap,
+                )
+                if on_gap is not None:
+                    try:
+                        on_gap(pod_id, topic, gap)
+                    except Exception:  # noqa: BLE001 — listener bugs
+                        logger.exception(
+                            "gap listener failed for pod %s", pod_id
+                        )
+
+    trace(logger, "message topic=%s seq=%d", topic, seq)
+    # seq_gap rides the message so a sampled ingestion trace can
+    # surface the publisher-side loss alongside queue/apply timing.
+    return Message(
+        topic=topic,
+        payload=payload,
+        pod_identifier=pod_id,
+        model_name=model,
+        seq=seq,
+        seq_gap=gap,
+    )
+
+
+@dataclass
 class ZMQSubscriberConfig:
     endpoint: str
     pod_identifier: str
@@ -60,25 +239,41 @@ class ZMQSubscriberConfig:
     # (reference: zmq_subscriber.go:92-105).
     bind: bool = False
 
+    def filter_bytes(self) -> bytes:
+        return topic_filter_bytes(self.topic_filter, self.pod_identifier)
+
 
 class ZMQSubscriber:
-    """One SUB socket + polling thread feeding a message sink."""
+    """LEGACY one SUB socket + dedicated polling thread per pod.
+
+    Superseded by the consolidated poller pool (``kvevents/poller.py``)
+    which multiplexes many pods' sockets onto a fixed thread pool —
+    thread count and idle wakeups scale with ``KVEVENTS_POLLERS``, not
+    fleet size.  This class is retained as the thread-per-pod baseline
+    for the ``event_storm`` bench regime (the A/B the consolidation is
+    measured against) and for single-socket tools; production paths go
+    through :class:`~.subscriber_manager.SubscriberManager`, which no
+    longer uses it.
+    """
 
     def __init__(
         self,
         config: ZMQSubscriberConfig,
         sink: Callable[[Message], None],
         context: Optional[zmq.Context] = None,
+        on_gap: Optional[GapListener] = None,
     ) -> None:
         self.config = config
         self._sink = sink
         self._context = context or zmq.Context.instance()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # Sequence numbers are independent per topic (model/LoRA streams
-        # from one pod each number from their own counter).
-        self._last_seq_by_topic: dict = {}
-        self.gap_count = 0
+        self._on_gap = on_gap
+        self.tracker = TopicSeqTracker()
+
+    @property
+    def gap_count(self) -> int:
+        return self.tracker.gap_count
 
     def start(self) -> None:
         if self._thread is not None:
@@ -96,20 +291,13 @@ class ZMQSubscriber:
             self._thread.join(timeout=10)
             self._thread = None
 
-    def _topic_filter(self) -> bytes:
-        if self.config.topic_filter is not None:
-            return self.config.topic_filter.encode()
-        return f"{TOPIC_PREFIX}{self.config.pod_identifier}@".encode()
-
     def _open_socket(self) -> zmq.Socket:
-        sock = self._context.socket(zmq.SUB)
-        sock.setsockopt(zmq.LINGER, 0)
-        sock.setsockopt(zmq.SUBSCRIBE, self._topic_filter())
-        if self.config.bind:
-            sock.bind(self.config.endpoint)
-        else:
-            sock.connect(self.config.endpoint)
-        return sock
+        return open_sub_socket(
+            self._context,
+            self.config.endpoint,
+            self.config.filter_bytes(),
+            self.config.bind,
+        )
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -148,62 +336,26 @@ class ZMQSubscriber:
                 )
 
     def _parse_message(self, parts) -> Optional[Message]:
-        # Dropped frames are event loss (stale scores for that pod
-        # until re-store), so every drop path logs at warning with
-        # enough context to find the misbehaving publisher.
-        if len(parts) != 3:
-            logger.warning(
-                "dropping %d-part message from %s (want [topic, seq, "
-                "payload])",
-                len(parts),
-                self.config.endpoint,
-            )
-            return None
-        topic_raw, seq_raw, payload = parts
-        try:
-            topic = topic_raw.decode()
-        except UnicodeDecodeError:
-            logger.warning(
-                "dropping message with undecodable topic from %s",
-                self.config.endpoint,
-            )
-            return None
-        parsed = parse_topic(topic)
-        if parsed is None:
-            logger.warning(
-                "dropping message with malformed topic %r from %s",
-                topic,
-                self.config.endpoint,
-            )
-            return None
-        pod_id, model = parsed
-
-        seq = 0
-        gap = 0
-        if len(seq_raw) == 8:
-            seq = struct.unpack(">Q", seq_raw)[0]
-            last_seq = self._last_seq_by_topic.get(topic)
-            if last_seq is not None and seq > last_seq + 1:
-                gap = seq - last_seq - 1
-                self.gap_count += gap
-                METRICS.kvevents_seq_gaps.labels(pod=pod_id).inc(gap)
-                logger.warning(
-                    "sequence gap on %s: %d -> %d (%d events lost)",
-                    topic,
-                    last_seq,
-                    seq,
-                    gap,
-                )
-            self._last_seq_by_topic[topic] = seq
-
-        trace(logger, "message topic=%s seq=%d", topic, seq)
-        # seq_gap rides the message so a sampled ingestion trace can
-        # surface the publisher-side loss alongside queue/apply timing.
-        return Message(
-            topic=topic,
-            payload=payload,
-            pod_identifier=pod_id,
-            model_name=model,
-            seq=seq,
-            seq_gap=gap,
+        return parse_event_message(
+            parts,
+            endpoint=self.config.endpoint,
+            pod_identifier=self.config.pod_identifier,
+            tracker=self.tracker,
+            on_gap=self._on_gap,
         )
+
+
+__all__ = [
+    "GapListener",
+    "POLL_INTERVAL_MS",
+    "RECONNECT_BACKOFF_SECONDS",
+    "SeqObservation",
+    "TOPIC_PREFIX",
+    "TopicSeqTracker",
+    "ZMQSubscriber",
+    "ZMQSubscriberConfig",
+    "open_sub_socket",
+    "parse_event_message",
+    "parse_topic",
+    "topic_filter_bytes",
+]

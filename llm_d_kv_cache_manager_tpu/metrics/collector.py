@@ -110,6 +110,57 @@ class KVCacheMetrics:
             ("pod",),
             registry=self.registry,
         )
+        self.kvevents_publisher_restarts = Counter(
+            f"{_NAMESPACE}_kvevents_publisher_restarts_total",
+            "Publisher restarts detected as per-topic sequence-number "
+            "regressions (counter reset); distinguished from gaps so an "
+            "engine restart does not inflate the loss signal.",
+            ("pod",),
+            registry=self.registry,
+        )
+        self.kvevents_pod_shed = Counter(
+            f"{_NAMESPACE}_kvevents_pod_shed_total",
+            "Event messages shed by per-pod flow control, by the pod "
+            "whose message was dropped (docs/event-plane.md).",
+            ("pod",),
+            registry=self.registry,
+        )
+        self.kvevents_pod_backlog = Gauge(
+            f"{_NAMESPACE}_kvevents_pod_backlog",
+            "Queued (not yet applied) event messages per pod in the "
+            "ingestion pool's shard lanes.",
+            ("pod",),
+            registry=self.registry,
+        )
+        self.kvevents_poller_sockets = Gauge(
+            f"{_NAMESPACE}_kvevents_poller_sockets",
+            "SUB sockets currently multiplexed by each consolidated "
+            "event-plane poller thread.",
+            ("poller",),
+            registry=self.registry,
+        )
+        self.kvevents_suspect_pods = Gauge(
+            f"{_NAMESPACE}_kvevents_suspect_pods",
+            "Pods whose index entries are suspect (sequence gap "
+            "detected, resync not yet completed).",
+            registry=self.registry,
+        )
+        self.kvevents_resyncs = Counter(
+            f"{_NAMESPACE}_kvevents_resyncs_total",
+            "Anti-entropy pod resyncs by outcome.",
+            ("outcome",),
+            registry=self.registry,
+        )
+        self.kvevents_resync_staleness = Histogram(
+            f"{_NAMESPACE}_kvevents_resync_staleness_seconds",
+            "Index-staleness window per resynced pod: first detected "
+            "gap to repair (purge + inventory re-apply) completed.",
+            registry=self.registry,
+            buckets=(
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 30.0, 60.0,
+            ),
+        )
         self.persistence_journal_records = Counter(
             f"{_NAMESPACE}_persistence_journal_records_total",
             "Index operations appended to the persistence journal by op.",

@@ -447,6 +447,141 @@ class TestEventPoolOrdering:
             pool.shutdown()
 
 
+class TestSubscriberManagerChurn:
+    """Fleet churn storm over the consolidated poller registry:
+    concurrent ensure_subscriber endpoint-flip restarts +
+    remove_subscriber + shutdown racing the poller threads.  Asserts
+    the registry stays consistent, no poller threads or sockets leak
+    (thread names are the observable; sockets close when their poller
+    exits or processes the detach — KV008 pins the static half), and
+    no events are delivered for a pod after its detach returned."""
+
+    def test_ensure_remove_shutdown_storm(self):
+        import uuid as _uuid
+
+        import zmq
+
+        from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
+            SubscriberManager,
+        )
+
+        def poller_threads():
+            return [
+                t
+                for t in threading.enumerate()
+                if t.name.startswith("kvtpu-evplane-poller-")
+            ]
+
+        before = len(poller_threads())
+        context = zmq.Context.instance()
+        run = _uuid.uuid4().hex
+        manager = SubscriberManager(
+            sink=lambda m: None,
+            context=context,
+            pollers=2,
+            poll_interval_ms=5,
+        )
+        pods = [f"churn-{run}-{i}" for i in range(16)]
+        stop = threading.Event()
+        errors = []
+
+        def churner(worker: int):
+            rng = random.Random(worker)
+            try:
+                while not stop.is_set():
+                    pod = rng.choice(pods)
+                    op = rng.random()
+                    if op < 0.5:
+                        # Endpoint flip forces detach+attach restarts.
+                        manager.ensure_subscriber(
+                            pod,
+                            f"tcp://10.255.0.{rng.randrange(1, 9)}:5557",
+                        )
+                    elif op < 0.8:
+                        manager.remove_subscriber(pod)
+                    else:
+                        manager.active_pods()
+            except Exception as exc:  # noqa: BLE001 - surfaced below
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=churner, args=(i,)) for i in range(THREADS)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors
+        # Registry consistent: every listed pod detaches cleanly.
+        for pod in manager.active_pods():
+            assert manager.remove_subscriber(pod)
+        assert manager.active_pods() == []
+        manager.shutdown()
+        # Shutdown is idempotent and racing churn can't resurrect it.
+        assert not manager.ensure_subscriber(
+            pods[0], "tcp://10.255.0.1:5557"
+        )
+        manager.shutdown()
+        assert len(poller_threads()) == before, (
+            "poller threads leaked by the churn storm"
+        )
+
+    def test_no_events_after_detach_under_churn(self):
+        import struct
+        import uuid as _uuid
+
+        import zmq
+
+        from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
+            SubscriberManager,
+        )
+
+        context = zmq.Context.instance()
+        run = _uuid.uuid4().hex
+        endpoint = f"inproc://churn-detach-{run}"
+        delivered = []
+        lock = threading.Lock()
+
+        def sink(message):
+            with lock:
+                delivered.append(message.seq)
+
+        pub = context.socket(zmq.PUB)
+        pub.setsockopt(zmq.LINGER, 0)
+        pub.bind(endpoint)
+        manager = SubscriberManager(
+            sink=sink, context=context, poll_interval_ms=5
+        )
+        try:
+            manager.ensure_subscriber("cd", endpoint)
+            seq = 0
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not delivered:
+                seq += 1
+                pub.send_multipart(
+                    [b"kv@cd@m", struct.pack(">Q", seq), b"p"]
+                )
+                time.sleep(0.02)
+            assert delivered, "subscription never became live"
+            manager.remove_subscriber("cd")
+            detach_marker = seq
+            for _ in range(50):
+                seq += 1
+                pub.send_multipart(
+                    [b"kv@cd@m", struct.pack(">Q", seq), b"p"]
+                )
+                time.sleep(0.002)
+            time.sleep(0.2)
+            with lock:
+                late = [s for s in delivered if s > detach_marker]
+            assert late == [], "events delivered after detach"
+        finally:
+            manager.shutdown()
+            pub.close()
+
+
 class TestTTLCacheUnderContention:
     def test_concurrent_set_sweep(self):
         evicted = []

@@ -1,0 +1,843 @@
+"""Event-plane fast lane: consolidated poller, per-pod flow control,
+gap-driven resync (docs/event-plane.md).
+
+Covers the fleet-scale subscription layer (PollerPool multiplexing many
+SUB sockets over a fixed thread pool), the ingestion pool's per-pod
+lanes (fairness property: a pod under its effective budget is never
+shed), the seq-tracker's gap / publisher-restart / duplicate
+classification, publisher thread-safety, and the anti-entropy resync
+state machine (suspect -> fetch -> purge + re-apply -> staleness
+report).
+"""
+
+import threading
+import time
+import uuid
+
+import pytest
+import zmq
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    EMPTY_BLOCK_HASH,
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+    PodEntry,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import InMemoryIndexConfig
+from llm_d_kv_cache_manager_tpu.kvevents.events import BlockStored, EventBatch
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    Message,
+    Pool,
+    PoolConfig,
+    ResyncJob,
+    _ShardQueue,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.poller import (
+    ChannelConfig,
+    PollerPool,
+    PollerPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher
+from llm_d_kv_cache_manager_tpu.kvevents.resync import (
+    CallableInventorySource,
+    EmptyInventorySource,
+    InventoryBlock,
+    PodInventory,
+    ResyncConfig,
+    ResyncManager,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
+    SubscriberManager,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+    TopicSeqTracker,
+    parse_event_message,
+)
+from llm_d_kv_cache_manager_tpu.metrics.collector import (
+    METRICS,
+    counter_total,
+)
+
+MODEL = "m"
+
+
+def _msg(pod: str, i: int = 0, resync=None) -> Message:
+    return Message(
+        topic=f"kv@{pod}@{MODEL}",
+        payload=str(i).encode(),
+        pod_identifier=pod,
+        model_name=MODEL,
+        seq=i,
+        resync=resync,
+    )
+
+
+def _labeled_total(counter, **labels) -> float:
+    total = 0.0
+    for metric in counter.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total") and all(
+                sample.labels.get(k) == v for k, v in labels.items()
+            ):
+                total += sample.value
+    return total
+
+
+class TestTopicSeqTracker:
+    def test_in_order_and_gap(self):
+        tracker = TopicSeqTracker()
+        assert tracker.observe("t", 1).gap == 0
+        assert tracker.observe("t", 2).gap == 0
+        observed = tracker.observe("t", 5)
+        assert observed.gap == 2 and not observed.restarted
+        assert tracker.gap_count == 2
+
+    def test_regression_is_restart_not_gap(self):
+        """Satellite: a publisher restart (counter reset to 1) resets
+        the watermark and counts a restart — NOT a gap."""
+        tracker = TopicSeqTracker()
+        tracker.observe("t", 41)
+        observed = tracker.observe("t", 1)
+        assert observed.restarted and observed.gap == 0
+        assert tracker.gap_count == 0
+        assert tracker.restart_count == 1
+        # Watermark reset: the restarted stream continues gap-free.
+        assert tracker.observe("t", 2).gap == 0
+        # And a real gap after the restart is still detected.
+        assert tracker.observe("t", 5).gap == 2
+
+    def test_duplicate_not_restart(self):
+        tracker = TopicSeqTracker()
+        tracker.observe("t", 7)
+        observed = tracker.observe("t", 7)
+        assert observed.duplicate
+        assert tracker.restart_count == 0
+        assert tracker.observe("t", 8).gap == 0
+
+    def test_topics_independent(self):
+        tracker = TopicSeqTracker()
+        tracker.observe("a", 10)
+        assert tracker.observe("b", 1).gap == 0
+        assert tracker.observe("a", 11).gap == 0
+
+    def test_parse_message_restart_metric_and_callback(self):
+        import struct
+
+        tracker = TopicSeqTracker()
+        gaps = []
+
+        def deliver(seq):
+            return parse_event_message(
+                [b"kv@rp@m", struct.pack(">Q", seq), b"x"],
+                endpoint="inproc://t",
+                pod_identifier="rp",
+                tracker=tracker,
+                on_gap=lambda pod, topic, gap: gaps.append((pod, gap)),
+            )
+
+        restarts_before = _labeled_total(
+            METRICS.kvevents_publisher_restarts, pod="rp"
+        )
+        gaps_before = _labeled_total(METRICS.kvevents_seq_gaps, pod="rp")
+        assert deliver(5) is not None
+        assert deliver(1) is not None  # restart
+        assert deliver(2) is not None
+        assert deliver(9) is not None  # gap of 6
+        assert deliver(9) is None  # duplicate: dropped
+        assert (
+            _labeled_total(METRICS.kvevents_publisher_restarts, pod="rp")
+            - restarts_before
+            == 1.0
+        )
+        assert (
+            _labeled_total(METRICS.kvevents_seq_gaps, pod="rp") - gaps_before
+            == 6.0
+        )
+        assert gaps == [("rp", 6)]
+
+
+class TestPublisherThreadSafety:
+    def test_concurrent_publish_unique_ordered_seqs(self):
+        """Satellite regression: unlocked `self._seq += 1` + send let
+        concurrent publishers interleave seq assignment and emit false
+        gaps.  With the lock, seqs are unique, dense, and each send
+        happens in seq order."""
+        context = zmq.Context.instance()
+        pub = Publisher(
+            f"inproc://pub-safety-{uuid.uuid4().hex}",
+            "pod-x",
+            MODEL,
+            bind=True,
+            context=context,
+        )
+        seqs = []
+        seq_lock = threading.Lock()
+        threads = 8
+        per_thread = 200
+        barrier = threading.Barrier(threads)
+
+        def worker():
+            barrier.wait()
+            mine = [
+                pub.publish(
+                    BlockStored(
+                        block_hashes=[1],
+                        parent_block_hash=None,
+                        token_ids=[1],
+                        block_size=1,
+                    )
+                )
+                for _ in range(per_thread)
+            ]
+            with seq_lock:
+                seqs.extend(mine)
+
+        pool = [threading.Thread(target=worker) for _ in range(threads)]
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join()
+        pub.close()
+        assert sorted(seqs) == list(range(1, threads * per_thread + 1))
+
+    def test_advance_seq_forces_gap(self):
+        context = zmq.Context.instance()
+        pub = Publisher(
+            f"inproc://pub-gap-{uuid.uuid4().hex}",
+            "pod-x",
+            MODEL,
+            bind=True,
+            context=context,
+        )
+        assert pub.publish() == 1
+        assert pub.advance_seq(3) == 4
+        assert pub.publish() == 5
+        pub.close()
+
+
+class TestShardQueueFlowControl:
+    def test_round_robin_drain(self):
+        q = _ShardQueue(max_depth=64, pod_budget=64, per_pod=True)
+        for i in range(3):
+            q.put(_msg("a", i))
+        for i in range(3):
+            q.put(_msg("b", i))
+        q.put(_msg("c", 0))
+        batch, closed, _ = q.get_batch(7)
+        assert not closed
+        order = [(m.pod_identifier, m.seq) for m in batch]
+        # One message per pod per rotation, per-pod FIFO preserved.
+        assert order == [
+            ("a", 0), ("b", 0), ("c", 0),
+            ("a", 1), ("b", 1),
+            ("a", 2), ("b", 2),
+        ]
+        q.task_done(len(batch))
+        q.join()
+
+    def test_pod_budget_self_shed(self):
+        q = _ShardQueue(max_depth=100, pod_budget=4, per_pod=True)
+        shed_all = []
+        for i in range(10):
+            shed, depth = q.put(_msg("a", i))
+            shed_all.extend(shed)
+            assert depth <= 4
+        assert len(shed_all) == 6
+        assert all(reason == "pod_budget" for _, reason in shed_all)
+        # Oldest shed first; newest survive in order.
+        assert [m.seq for m, _ in shed_all] == list(range(6))
+        batch, _, _ = q.get_batch(10)
+        assert [m.seq for m in batch] == [6, 7, 8, 9]
+
+    def test_fairness_property_quiet_pod_never_shed(self):
+        """THE fairness property: a pod under its effective budget
+        (min(pod_budget, max_depth // active pods)) is never shed, no
+        matter how chatty its shard neighbors are."""
+        q = _ShardQueue(max_depth=16, pod_budget=16, per_pod=True)
+        # Quiet pod: 3 messages (< 16 // 2 = 8 fair share).
+        for i in range(3):
+            q.put(_msg("quiet", i))
+        # Chatty pod floods far past the shard bound.
+        shed_all = []
+        for i in range(100):
+            shed, _ = q.put(_msg("chatty", i))
+            shed_all.extend(shed)
+        assert shed_all, "the flood must shed"
+        assert all(
+            m.pod_identifier == "chatty" for m, _ in shed_all
+        ), "only the over-budget pod pays for its own flood"
+        depths = q.lane_depths()
+        assert depths["quiet"] == 3
+        assert depths["quiet"] + depths["chatty"] <= 16
+
+    def test_overflow_reason_is_queue_full_not_pod_budget(self):
+        """Whole-shard overflow keeps its long-documented queue_full
+        reason even when the overflowing lane also sits at its budget
+        — in legacy single-lane mode (budget == depth) every overflow
+        would otherwise be relabeled pod_budget, silencing dashboards
+        keyed on queue_full."""
+        legacy = _ShardQueue(max_depth=4, pod_budget=4, per_pod=False)
+        shed_all = []
+        for i in range(6):
+            shed, _ = legacy.put(_msg("a", i))
+            shed_all.extend(shed)
+        assert shed_all and all(
+            reason == "queue_full" for _, reason in shed_all
+        )
+        # Same at a full per-pod shard monopolized by one lane.
+        per_pod = _ShardQueue(max_depth=4, pod_budget=4, per_pod=True)
+        shed_all = []
+        for i in range(6):
+            shed, _ = per_pod.put(_msg("a", i))
+            shed_all.extend(shed)
+        assert shed_all and all(
+            reason == "queue_full" for _, reason in shed_all
+        )
+
+    def test_global_fifo_compat_mode(self):
+        q = _ShardQueue(max_depth=4, pod_budget=4, per_pod=False)
+        shed_all = []
+        for i in range(6):
+            shed, _ = q.put(_msg("a" if i % 2 else "b", i))
+            shed_all.extend(shed)
+        # Legacy drop-oldest: the two OLDEST messages shed regardless
+        # of pod.
+        assert [m.seq for m, _ in shed_all] == [0, 1]
+        batch, _, _ = q.get_batch(10)
+        assert [m.seq for m in batch] == [2, 3, 4, 5]
+
+    def test_commands_never_shed(self):
+        q = _ShardQueue(max_depth=2, pod_budget=2, per_pod=True)
+        job = ResyncJob(pod_identifier="a", model_name=MODEL)
+        q.put(_msg("a", 0, resync=job))
+        shed_all = []
+        for i in range(1, 6):
+            shed, _ = q.put(_msg("a", i))
+            shed_all.extend(shed)
+        assert all(m.resync is None for m, _ in shed_all)
+        batch, _, _ = q.get_batch(10)
+        assert batch[0].resync is job
+
+    def test_closed_queue_rejects(self):
+        q = _ShardQueue(max_depth=4, pod_budget=4, per_pod=True)
+        q.put(_msg("a", 0))
+        q.close()
+        shed, depth = q.put(_msg("a", 1))
+        assert depth == -1 and shed[0][1] == "shutdown"
+        # Close drains the remainder, then reports closed.
+        batch, closed, _ = q.get_batch(10)
+        assert [m.seq for m in batch] == [0] and not closed
+        q.task_done(1)
+        batch, closed, _ = q.get_batch(10)
+        assert closed and not batch
+
+
+class TestPoolFlowControl:
+    def test_chatty_pod_cannot_starve_shard_neighbor(self):
+        """Pool-level fairness: flood one pod of an UNSTARTED pool (so
+        the shard backs up) and the co-sharded quiet pod's messages all
+        survive; per-pod shed metrics name only the chatty pod."""
+        index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = Pool(
+            index, db, PoolConfig(concurrency=1, max_queue_depth=8)
+        )
+        chatty_before = _labeled_total(
+            METRICS.kvevents_pod_shed, pod="chatty"
+        )
+        quiet_before = _labeled_total(METRICS.kvevents_pod_shed, pod="quiet")
+
+        def stored(i):
+            return BlockStored(
+                block_hashes=[i + 1],
+                parent_block_hash=None,
+                token_ids=[1, 2, 3, 4],
+                block_size=4,
+            )
+
+        def deliver(pod, i):
+            batch = EventBatch(ts=float(i), events=[stored(i)])
+            pool.add_task(
+                Message(
+                    topic=f"kv@{pod}@{MODEL}",
+                    payload=batch.encode(),
+                    pod_identifier=pod,
+                    model_name=MODEL,
+                )
+            )
+
+        for i in range(3):
+            deliver("quiet", i)
+        for i in range(50):
+            deliver("chatty", i)
+        # Both pods always co-shard at concurrency=1.
+        shard = pool._shard_for("quiet")
+        assert shard is pool._shard_for("chatty")
+        depths = shard.lane_depths()
+        assert depths["quiet"] == 3
+        assert (
+            _labeled_total(METRICS.kvevents_pod_shed, pod="quiet")
+            == quiet_before
+        )
+        assert (
+            _labeled_total(METRICS.kvevents_pod_shed, pod="chatty")
+            > chatty_before
+        )
+        pool.start()
+        pool.drain()
+        pool.shutdown()
+
+
+def _make_pool(block_size=4, **kw):
+    index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+    db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=block_size))
+    pool = Pool(index, db, PoolConfig(concurrency=2, **kw))
+    pool.start()
+    return pool, index, db
+
+
+class TestResync:
+    def _seed_stale(self, pool, index, db, pod="pod-r"):
+        """Apply one live event, then plant a STALE entry the inventory
+        will not contain."""
+        tokens = [1, 2, 3, 4]
+        batch = EventBatch(
+            ts=1.0,
+            events=[
+                BlockStored(
+                    block_hashes=[0xA],
+                    parent_block_hash=None,
+                    token_ids=tokens,
+                    block_size=4,
+                )
+            ],
+        )
+        pool.add_task(
+            Message(
+                topic=f"kv@{pod}@{MODEL}",
+                payload=batch.encode(),
+                pod_identifier=pod,
+                model_name=MODEL,
+            )
+        )
+        pool.drain()
+        keys = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, MODEL)
+        assert index.lookup(keys)
+        return keys
+
+    def test_resync_purges_and_reapplies_inventory(self):
+        pool, index, db = _make_pool()
+        pod = "pod-r"
+        stale_keys = self._seed_stale(pool, index, db, pod)
+        fresh_tokens = [9, 9, 9, 9, 8, 8, 8, 8]
+
+        source = CallableInventorySource(
+            lambda p: PodInventory(
+                pod_identifier=p,
+                model_name=MODEL,
+                blocks=[
+                    InventoryBlock(
+                        block_hashes=[0xB1, 0xB2],
+                        token_ids=fresh_tokens,
+                        block_size=4,
+                        medium="hbm",
+                    )
+                ],
+            )
+        )
+        manager = ResyncManager(pool, source, ResyncConfig())
+        manager.start()
+        ok_before = counter_total(METRICS.kvevents_resyncs)
+        assert manager.mark_suspect(pod, MODEL)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and manager.is_suspect(pod):
+            time.sleep(0.01)
+        assert not manager.is_suspect(pod), manager.stats()
+        manager.close()
+        pool.shutdown()
+        # Stale claim gone, inventory claims present.
+        assert not index.lookup(stale_keys)
+        fresh_keys = db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, fresh_tokens, MODEL
+        )
+        found = index.lookup(fresh_keys)
+        assert set(found) == set(fresh_keys)
+        assert found[fresh_keys[0]] == [PodEntry(pod, "hbm")]
+        assert counter_total(METRICS.kvevents_resyncs) > ok_before
+        assert manager.stats()["resyncs_ok"] >= 1
+
+    def test_empty_source_is_purge_only(self):
+        pool, index, db = _make_pool()
+        pod = "pod-r"
+        stale_keys = self._seed_stale(pool, index, db, pod)
+        manager = ResyncManager(pool, EmptyInventorySource())
+        manager.start()
+        manager.mark_suspect(pod)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and manager.is_suspect(pod):
+            time.sleep(0.01)
+        assert not manager.is_suspect(pod)
+        manager.close()
+        pool.shutdown()
+        assert not index.lookup(stale_keys)
+
+    def test_failing_source_leaves_pod_suspect(self):
+        pool, index, db = _make_pool()
+        pod = "pod-r"
+        self._seed_stale(pool, index, db, pod)
+        failed_before = None
+        manager = ResyncManager(
+            pool,
+            CallableInventorySource(lambda p: None),
+            ResyncConfig(max_attempts=2, retry_backoff_s=0.01),
+        )
+        manager.start()
+        manager.mark_suspect(pod)
+        deadline = time.monotonic() + 10
+        while (
+            time.monotonic() < deadline
+            and manager.stats()["resyncs_failed"] == 0
+        ):
+            time.sleep(0.01)
+        stats = manager.stats()
+        assert stats["resyncs_failed"] >= 1
+        assert manager.is_suspect(pod), "failed resync must keep suspicion"
+        manager.close()
+        pool.shutdown()
+        assert failed_before is None  # silence lint: var used as marker
+
+    def test_mark_suspect_idempotent_while_suspect(self):
+        pool, _index, _db = _make_pool()
+        manager = ResyncManager(
+            pool, CallableInventorySource(lambda p: None),
+            ResyncConfig(max_attempts=1, retry_backoff_s=0.01),
+        )
+        # NOT started: marks accumulate without being consumed.
+        assert manager.mark_suspect("p1")
+        assert not manager.mark_suspect("p1")
+        assert manager.suspect_pods() == ["p1"]
+        manager.close()
+        pool.shutdown()
+
+    def test_resync_ordered_with_live_events(self):
+        """A resync job rides the pod's shard lane: events enqueued
+        BEFORE it are purged; events enqueued AFTER it survive."""
+        pool, index, db = _make_pool()
+        pod = "pod-r"
+        stale_keys = self._seed_stale(pool, index, db, pod)
+
+        done = threading.Event()
+        job = ResyncJob(
+            pod_identifier=pod,
+            model_name=MODEL,
+            events=[],
+            on_done=lambda j, ok, purged, detail: done.set(),
+        )
+        pool.enqueue_resync(job)
+        after_tokens = [5, 5, 5, 5]
+        batch = EventBatch(
+            ts=2.0,
+            events=[
+                BlockStored(
+                    block_hashes=[0xC],
+                    parent_block_hash=None,
+                    token_ids=after_tokens,
+                    block_size=4,
+                )
+            ],
+        )
+        pool.add_task(
+            Message(
+                topic=f"kv@{pod}@{MODEL}",
+                payload=batch.encode(),
+                pod_identifier=pod,
+                model_name=MODEL,
+            )
+        )
+        pool.drain()
+        assert done.wait(5)
+        assert not index.lookup(stale_keys), "pre-resync state purged"
+        after_keys = db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, after_tokens, MODEL
+        )
+        assert index.lookup(after_keys), "post-resync event survived"
+        pool.shutdown()
+
+    def test_shutdown_fails_pending_job(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=100))
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = Pool(index, db, PoolConfig(concurrency=1))
+        # Never started: the queued job must still be reported failed.
+        outcome = {}
+
+        def on_done(job, ok, purged, detail):
+            outcome["ok"] = ok
+            outcome["detail"] = detail
+
+        pool.enqueue_resync(
+            ResyncJob(
+                pod_identifier="p", model_name=MODEL, on_done=on_done
+            )
+        )
+        pool._started = True
+        pool.shutdown()
+        assert outcome == {"ok": False, "detail": "pool shutdown"}
+
+
+class TestPollerPool:
+    def test_many_pods_one_poller_inproc(self):
+        context = zmq.Context.instance()
+        run = uuid.uuid4().hex
+        pods = [f"pp-{run}-{i}" for i in range(16)]
+        received = []
+        lock = threading.Lock()
+
+        def sink(message):
+            with lock:
+                received.append((message.pod_identifier, message.payload))
+
+        publishers = {
+            pod: Publisher(
+                f"inproc://{pod}", pod, MODEL, bind=True, context=context
+            )
+            for pod in pods
+        }
+        pool = PollerPool(
+            context=context,
+            config=PollerPoolConfig(pollers=1, poll_interval_ms=10),
+        )
+        channels = {
+            pod: pool.attach(
+                ChannelConfig(endpoint=f"inproc://{pod}", pod_identifier=pod),
+                sink,
+            )
+            for pod in pods
+        }
+        try:
+            deadline = time.monotonic() + 15
+            seen = set()
+            while time.monotonic() < deadline and len(seen) < len(pods):
+                for pod in pods:
+                    publishers[pod].publish(
+                        BlockStored(
+                            block_hashes=[1],
+                            parent_block_hash=None,
+                            token_ids=[1],
+                            block_size=1,
+                        )
+                    )
+                time.sleep(0.05)
+                with lock:
+                    seen = {pod for pod, _ in received}
+            assert seen == set(pods)
+            # One poller thread serves all 16 pods.
+            evplane = [
+                t.name
+                for t in threading.enumerate()
+                if t.name.startswith("kvtpu-evplane-poller-")
+            ]
+            assert len(evplane) == 1
+        finally:
+            for channel in channels.values():
+                pool.detach(channel)
+            pool.shutdown()
+            for pub in publishers.values():
+                pub.close()
+
+    def test_no_delivery_after_detach(self):
+        context = zmq.Context.instance()
+        run = uuid.uuid4().hex
+        endpoint = f"inproc://detach-{run}"
+        received = []
+        lock = threading.Lock()
+
+        def sink(message):
+            with lock:
+                received.append(message.seq)
+
+        pub = Publisher(endpoint, "dp", MODEL, bind=True, context=context)
+        pool = PollerPool(
+            context=context,
+            config=PollerPoolConfig(pollers=1, poll_interval_ms=5),
+        )
+        channel = pool.attach(
+            ChannelConfig(endpoint=endpoint, pod_identifier="dp"), sink
+        )
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not received:
+                pub.publish()
+                time.sleep(0.02)
+            assert received, "subscription never became live"
+            pool.detach(channel)
+            marker_start = pub.advance_seq(0)
+            for _ in range(20):
+                pub.publish()
+                time.sleep(0.005)
+            time.sleep(0.2)
+            with lock:
+                late = [s for s in received if s > marker_start]
+            assert late == [], "events delivered after detach"
+        finally:
+            pool.shutdown()
+            pub.close()
+
+    def test_least_loaded_distribution(self):
+        context = zmq.Context.instance()
+        pool = PollerPool(
+            context=context,
+            config=PollerPoolConfig(pollers=2, poll_interval_ms=10),
+        )
+        channels = [
+            pool.attach(
+                ChannelConfig(
+                    endpoint="tcp://10.255.0.1:1",
+                    pod_identifier=f"lb-{i}",
+                ),
+                lambda m: None,
+            )
+            for i in range(8)
+        ]
+        by_poller = {}
+        for channel in channels:
+            by_poller.setdefault(channel.poller_index, 0)
+            by_poller[channel.poller_index] += 1
+        assert by_poller == {0: 4, 1: 4}
+        pool.shutdown()
+
+
+class TestSubscriberManagerRegistry:
+    def test_gap_listener_wired_to_channels(self):
+        import struct
+
+        context = zmq.Context.instance()
+        run = uuid.uuid4().hex
+        endpoint = f"inproc://gap-{run}"
+        gaps = []
+        sunk = []
+        manager = SubscriberManager(
+            sink=sunk.append,
+            context=context,
+            poll_interval_ms=5,
+            on_gap=lambda pod, topic, gap: gaps.append((pod, gap)),
+        )
+        pub_sock = context.socket(zmq.PUB)
+        pub_sock.setsockopt(zmq.LINGER, 0)
+        pub_sock.bind(endpoint)
+        manager.ensure_subscriber("gp", endpoint)
+        try:
+            deadline = time.monotonic() + 15
+            seq = 0
+            while time.monotonic() < deadline and not sunk:
+                seq += 1
+                pub_sock.send_multipart(
+                    [b"kv@gp@m", struct.pack(">Q", seq), b"p"]
+                )
+                time.sleep(0.02)
+            assert sunk, "subscription never became live"
+            # Force a gap of 5.
+            seq += 5
+            pub_sock.send_multipart(
+                [b"kv@gp@m", struct.pack(">Q", seq + 1), b"p"]
+            )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not gaps:
+                time.sleep(0.01)
+            assert gaps and gaps[0][0] == "gp" and gaps[0][1] >= 5
+            assert manager.gap_count("gp") >= 5
+        finally:
+            manager.shutdown()
+            pub_sock.close()
+
+    def test_shutdown_stops_poller_threads(self):
+        manager = SubscriberManager(sink=lambda m: None, poll_interval_ms=5)
+        manager.ensure_subscriber("sp", "tcp://10.255.0.9:5557")
+        assert any(
+            t.name.startswith("kvtpu-evplane-poller-")
+            for t in threading.enumerate()
+        )
+        manager.shutdown()
+        assert not any(
+            t.name.startswith("kvtpu-evplane-poller-")
+            for t in threading.enumerate()
+        )
+        # Post-shutdown ensure is refused, not resurrected.
+        assert not manager.ensure_subscriber("sp", "tcp://10.255.0.9:5557")
+
+    def test_dead_poller_replaced_on_attach(self):
+        """A crashed poller thread must not keep collecting attach
+        assignments: the pool replaces it on the next attach so fresh
+        subscriptions land on a live thread and deliver."""
+        context = zmq.Context.instance()
+        run = uuid.uuid4().hex
+        pool = PollerPool(
+            context=context,
+            config=PollerPoolConfig(pollers=1, poll_interval_ms=5),
+        )
+        received = []
+        lock = threading.Lock()
+
+        def sink(message):
+            with lock:
+                received.append(message.pod_identifier)
+
+        first = pool.attach(
+            ChannelConfig(
+                endpoint=f"inproc://dead-{run}-a", pod_identifier="pa"
+            ),
+            sink,
+        )
+        # Simulate a poller crash: stop its thread directly, leaving
+        # the pool itself running.
+        dead = pool._pollers[0]
+        dead._stop.set()
+        dead._thread.join(timeout=10)
+        assert not dead.alive()
+        pub = Publisher(
+            f"inproc://dead-{run}-b", "pb", MODEL, bind=True,
+            context=context,
+        )
+        try:
+            channel = pool.attach(
+                ChannelConfig(
+                    endpoint=f"inproc://dead-{run}-b",
+                    pod_identifier="pb",
+                ),
+                sink,
+            )
+            assert pool._pollers[0] is not dead, "dead poller not replaced"
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and "pb" not in received:
+                pub.publish()
+                time.sleep(0.02)
+            with lock:
+                assert "pb" in received, (
+                    "attach after a poller crash never delivered"
+                )
+            pool.detach(channel)
+            pool.detach(first)
+        finally:
+            pool.shutdown()
+            pub.close()
+
+    def test_kvevents_package_kvlint_clean(self):
+        """The whole event plane stays kvlint-clean with an empty
+        baseline (KV001-KV008, incl. the resource-leak rule over the
+        poller's sockets/threads)."""
+        import io
+        import contextlib
+
+        from hack.kvlint.__main__ import main as kvlint_main
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = kvlint_main(
+                [
+                    "llm_d_kv_cache_manager_tpu/kvevents",
+                    "--no-baseline",
+                    "--rules",
+                    "KV001,KV003,KV004,KV005,KV008",
+                ]
+            )
+        assert rc == 0, buf.getvalue()

@@ -340,7 +340,7 @@ class TestBoundedQueues:
         assert pool._queues[0].qsize() == depth
         assert self._dropped_total() - before == flood - depth
         # The survivors are the NEWEST messages, still in order.
-        queued = list(pool._queues[0].queue)
+        queued = pool._queues[0].snapshot()
         timestamps = [decode_event_batch(m.payload).ts for m in queued]
         assert timestamps == [float(i) for i in range(flood - depth, flood)]
         # Draining after start processes exactly the survivors.
@@ -366,8 +366,11 @@ class TestBoundedQueues:
             wedged.add_task(self._message(i))
         wedged._started = True  # simulate started-but-stuck workers
         wedged._threads = []
-        wedged.shutdown()  # must not deadlock inserting the sentinel
-        assert wedged._queues[0].queue[-1] is None
+        wedged.shutdown()  # must not deadlock closing the shard queues
+        assert wedged._queues[0]._closed
+        # A post-shutdown put is rejected (and counted), never queued.
+        wedged.add_task(self._message(99))
+        assert wedged._queues[0].qsize() == 2
         pool.shutdown()
 
     def test_invalid_depth_rejected(self):
