@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke cachestats-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -67,6 +67,16 @@ obs-smoke:
 # asserting sane output + fast-lane score parity (docs/performance.md).
 perf-smoke:
 	$(CPU_ENV) $(PYTHON) hack/perf_smoke.py
+
+# Cache-analytics smoke (same invocation as CI's "Cache analytics
+# smoke" step): booted service with the hit-attribution ledger + an
+# auditor over a controllable inventory — scored traffic lands in
+# /debug/cachestats (totals, windows, family drill-down), a planted
+# divergence is detected within one audit cycle, /healthz carries the
+# analytics block, and the new metric families are on /metrics
+# (docs/observability.md).
+cachestats-smoke:
+	$(CPU_ENV) $(PYTHON) hack/cachestats_smoke.py
 
 # Event-plane smoke (same invocation as CI's "Event-plane smoke"
 # step): consolidated poller over ~64 inproc publishers — throughput

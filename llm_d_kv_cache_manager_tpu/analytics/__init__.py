@@ -1,0 +1,37 @@
+"""Cache-efficiency analytics: hit-attribution ledger + index-truth
+auditor (docs/observability.md).
+
+The auditor names are lazy (PEP 562): they pull the kvevents stack
+(and transitively zmq) for the ``InventorySource`` contract, which the
+ledger — constructed by every ``Indexer`` — must not drag onto the
+scoring path's import graph.
+"""
+
+from llm_d_kv_cache_manager_tpu.analytics.ledger import (
+    CacheStatsLedger,
+    LedgerConfig,
+)
+from llm_d_kv_cache_manager_tpu.analytics.windows import (
+    Frame,
+    WindowRing,
+    standard_windows,
+)
+
+_AUDITOR_EXPORTS = ("AuditorConfig", "AuditReport", "IndexAuditor")
+
+__all__ = [
+    "CacheStatsLedger",
+    "LedgerConfig",
+    "Frame",
+    "WindowRing",
+    "standard_windows",
+    *_AUDITOR_EXPORTS,
+]
+
+
+def __getattr__(name):
+    if name in _AUDITOR_EXPORTS:
+        from llm_d_kv_cache_manager_tpu.analytics import auditor
+
+        return getattr(auditor, name)
+    raise AttributeError(name)

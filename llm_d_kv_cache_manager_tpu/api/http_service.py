@@ -51,6 +51,7 @@ def _make_handler(
     persistence=None,
     recovery_report=None,
     event_plane_status=None,
+    auditor=None,
 ):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -210,13 +211,66 @@ def _make_handler(
                     except Exception:  # noqa: BLE001 — health must answer
                         logger.exception("event-plane status failed")
                         health["event_plane"] = {"error": "unavailable"}
+                analytics = {}
+                try:
+                    if indexer.cache_stats is not None:
+                        analytics["cachestats"] = (
+                            indexer.cache_stats.stats_summary()
+                        )
+                    if auditor is not None:
+                        analytics["audit"] = auditor.status()
+                except Exception:  # noqa: BLE001 — health must answer
+                    logger.exception("analytics status failed")
+                    analytics = {"error": "unavailable"}
+                if analytics:
+                    health["analytics"] = analytics
                 self._reply_json(200, health)
             elif path == "/debug/traces":
                 self._debug_traces(query)
             elif path.startswith("/debug/traces/"):
                 self._debug_trace_by_id(path[len("/debug/traces/"):])
+            elif path == "/debug/cachestats":
+                self._debug_cachestats(query)
             else:
                 self._error(404, "not found")
+
+        def _debug_cachestats(self, query):
+            """Read-only cache-efficiency analytics: ledger totals,
+            windows, reuse distances, top families (?top=N), one
+            family's drill-down (?family=<16-hex id from a listing>),
+            and the index-truth audit plane (docs/observability.md)."""
+            ledger = indexer.cache_stats
+            if ledger is None:
+                self._error(404, "cache analytics disabled (CACHESTATS=0)")
+                return
+            family_raw = query.get("family")
+            if family_raw:
+                try:
+                    family = int(family_raw, 16)
+                except ValueError:
+                    self._error(400, "invalid 'family' (expect hex id)")
+                    return
+                detail = ledger.family_detail(family)
+                if detail is None:
+                    self._error(
+                        404, "family not tracked (evicted or never seen)"
+                    )
+                    return
+                self._reply_json(200, detail)
+                return
+            try:
+                top = max(1, min(int(query.get("top", "20")), 500))
+            except ValueError:
+                self._error(400, "invalid 'top'")
+                return
+            payload = ledger.snapshot(top=top)
+            if auditor is not None:
+                payload["audit"] = auditor.status()
+                payload["audit_log"] = auditor.recent(20)
+                divergent = auditor.divergent(20)
+                if divergent:
+                    payload["audit_divergent"] = divergent
+            self._reply_json(200, payload)
 
         def _debug_traces(self, query):
             """Read-only flight-recorder listing (span-free summaries;
@@ -477,6 +531,7 @@ def serve(
     persistence=None,
     recovery_report=None,
     event_plane_status=None,
+    auditor=None,
 ) -> http.server.ThreadingHTTPServer:
     """Start the HTTP service on a background thread; returns the server
     (call ``.shutdown()`` to stop).  ``admin_token`` (env:
@@ -485,7 +540,11 @@ def serve(
     ``PersistenceManager``) enables ``POST /admin/snapshot`` and the
     persistence block in ``/healthz``; ``recovery_report`` surfaces the
     startup recovery outcome there too; ``event_plane_status`` (a
-    zero-arg callable) adds the event-plane block."""
+    zero-arg callable) adds the event-plane block.  The indexer's
+    hit-attribution ledger (``indexer.cache_stats``) backs
+    ``GET /debug/cachestats`` and the ``/healthz`` analytics block;
+    ``auditor`` (an ``analytics.IndexAuditor``) adds the index-truth
+    audit plane to both."""
     server = http.server.ThreadingHTTPServer(
         (host, port),
         _make_handler(
@@ -494,6 +553,7 @@ def serve(
             persistence=persistence,
             recovery_report=recovery_report,
             event_plane_status=event_plane_status,
+            auditor=auditor,
         ),
     )
     thread = threading.Thread(

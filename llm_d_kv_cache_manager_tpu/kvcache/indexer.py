@@ -89,6 +89,16 @@ def _env_fast_lane_default() -> Optional[bool]:
     return raw.strip().lower() not in ("0", "false", "off")
 
 
+def _env_cache_stats_default() -> bool:
+    """CACHESTATS: "0"/"false"/"off" disables the hit-attribution
+    ledger; unset/anything else keeps it on (sampling is governed
+    separately by CACHESTATS_SAMPLE_RATE — docs/observability.md)."""
+    raw = os.environ.get("CACHESTATS")
+    if raw is None:
+        return True
+    return raw.strip().lower() not in ("0", "false", "off")
+
+
 def _env_score_memo_default() -> Optional[int]:
     """READ_PATH_SCORE_MEMO: "0"/"false"/"off" disables, a positive
     integer sizes the memo, unset defers to the config default."""
@@ -115,9 +125,21 @@ class _ScoreMemoEntry:
     VALUES while preserving their count, and stale tokens mean stale
     block keys) — and the chain keys the walk consumed (touched on
     every hit so LRU recency, hence eviction order, stays identical to
-    the walk the memo elides)."""
+    the walk the memo elides).  Entries also carry the walk's analytics
+    attribution (family key, matched blocks, tier split) so a memo hit
+    replays the same ledger record the elided walk would have
+    produced."""
 
-    __slots__ = ("scores", "version", "tokens", "touch_keys", "max_pod_hits")
+    __slots__ = (
+        "scores",
+        "version",
+        "tokens",
+        "touch_keys",
+        "max_pod_hits",
+        "family",
+        "matched_blocks",
+        "tier_counts",
+    )
 
     def __init__(
         self,
@@ -126,12 +148,46 @@ class _ScoreMemoEntry:
         tokens: tuple,
         touch_keys: tuple,
         max_pod_hits: int,
+        family: Optional[int] = None,
+        matched_blocks: int = 0,
+        tier_counts: Optional[Dict[str, int]] = None,
     ) -> None:
         self.scores = scores
         self.version = version
         self.tokens = tokens
         self.touch_keys = touch_keys
         self.max_pod_hits = max_pod_hits
+        self.family = family
+        self.matched_blocks = matched_blocks
+        self.tier_counts = tier_counts
+
+
+# Traced provenance attr is bounded: past this many candidate pods the
+# attr keeps the best matchers (the ones a slow-trace reader needs).
+_PROVENANCE_MAX_PODS = 32
+
+
+def _provenance_attr(chain) -> Dict[str, dict]:
+    """Per-pod ``{blocks_matched, break_index}`` span attribute for a
+    traced scoring request (cross-link: a slow trace in /debug/traces
+    is diagnosable without re-issuing ``?explain=1``), size-capped."""
+    provenance = chain.provenance()
+    if len(provenance) <= _PROVENANCE_MAX_PODS:
+        return provenance
+    top = sorted(
+        provenance.items(),
+        key=lambda item: (-item[1]["blocks_matched"], item[0]),
+    )[:_PROVENANCE_MAX_PODS]
+    return dict(top)
+
+
+def _ledger_record(ledger, family, model_name, total, matched, tiers) -> None:
+    """Analytics must never fail a scoring request: a ledger bug is
+    loud (logged with stack) but non-fatal."""
+    try:
+        ledger.record(family, model_name, total, matched, tiers)
+    except Exception:  # noqa: BLE001 - scoring outlives analytics bugs
+        logger.exception("cache-stats record failed")
 
 
 @dataclass
@@ -166,6 +222,11 @@ class IndexerConfig:
     # backend; others silently run without the memo).  Entries pin
     # their prompt strings, so memory is O(size x prompt length).
     score_memo_size: Optional[int] = None
+    # Cache-efficiency analytics (analytics/ledger.py): every scored
+    # request feeds the hit-attribution ledger, outside index locks,
+    # gated by CACHESTATS_SAMPLE_RATE.  None resolves from the
+    # CACHESTATS env knob (default on); False disables.
+    cache_stats: Optional[bool] = None
 
 
 class Indexer:
@@ -177,6 +238,7 @@ class Indexer:
         token_processor: Optional[TokenProcessor] = None,
         tokenizer: Optional[Tokenizer] = None,
         chat_processor: Optional[ChatTemplatingProcessor] = None,
+        cache_stats_ledger=None,
     ) -> None:
         self.config = config or IndexerConfig()
         self.token_processor = token_processor or ChunkedTokenDatabase(
@@ -251,6 +313,25 @@ class Indexer:
         ):
             self._score_memo = LRUCache(memo_size)
 
+        # Hit-attribution ledger (analytics/ledger.py): an explicit
+        # ledger always wins (tests, bench A/B share one ledger across
+        # indexers); otherwise construct from env unless disabled.
+        # Only a ledger this Indexer constructed is closed by its
+        # shutdown — an injected one belongs to the caller.
+        self.cache_stats = cache_stats_ledger
+        self._owns_ledger = False
+        if self.cache_stats is None:
+            enabled = self.config.cache_stats
+            if enabled is None:
+                enabled = _env_cache_stats_default()
+            if enabled:
+                from llm_d_kv_cache_manager_tpu.analytics.ledger import (
+                    CacheStatsLedger,
+                )
+
+                self.cache_stats = CacheStatsLedger()
+                self._owns_ledger = True
+
         if tokenizer is None:
             backends: List[Tokenizer] = []
             if self.config.local_tokenizers_dir:
@@ -278,6 +359,8 @@ class Indexer:
 
     def shutdown(self) -> None:
         self.tokenization_pool.shutdown()
+        if self._owns_ledger:
+            self.cache_stats.close()
 
     def set_tokenizer(self, tokenizer: Tokenizer, model_name: str) -> None:
         self.tokenization_pool.set_tokenizer(tokenizer, model_name)
@@ -336,22 +419,46 @@ class Indexer:
         render_req: Optional[ApplyChatTemplateRequest] = None,
     ) -> Dict[str, float]:
         """The pre-fast-lane path: hash every block, one lookup, one
-        scoring pass.  Kept verbatim as the parity oracle
-        (READ_PATH_FAST_LANE=0) and the fallback when the fast lane is
-        configured off."""
+        scoring pass (the same ``begin``/``advance`` drive ``score()``
+        wraps, unrolled here so the chain's attribution state is
+        readable).  Kept as the parity oracle (READ_PATH_FAST_LANE=0)
+        and the fallback when the fast lane is configured off."""
         _, block_keys = self._tokens_and_block_keys(
             prompt, model_name, render_req
         )
         if not block_keys:
             return {}
 
+        ledger = self.cache_stats
+        sampled = ledger is not None and ledger.should_sample()
+        track_tiers = sampled and ledger.tier_detail_due()
+        traced = current_trace() is not None
         pod_set = set(pod_identifiers) if pod_identifiers else None
         with obs_span("index_lookup") as s:
             key_to_pods = self.kv_block_index.lookup(block_keys, pod_set)
             s.set_attr("keys_hit", len(key_to_pods))
         with obs_span("score") as s:
-            scores = self.scorer.score(block_keys, key_to_pods)
+            chain = self.scorer.begin(
+                track_tiers=track_tiers, track_deaths=traced
+            )
+            # lookup() already applied the pod filter; feeding every
+            # key keeps break indices aligned with explain's.
+            self.scorer.advance(
+                chain, [key_to_pods.get(key, ()) for key in block_keys]
+            )
+            scores = chain.scores
             s.set_attr("pods", len(scores))
+            if traced:
+                s.set_attr("provenance", _provenance_attr(chain))
+        if sampled:
+            _ledger_record(
+                ledger,
+                ledger.family_key(block_keys, len(block_keys)),
+                model_name,
+                len(block_keys),
+                chain.matched_blocks,
+                chain.tier_counts,
+            )
         logger.debug(
             "scored %d pods over %d block keys", len(scores), len(block_keys)
         )
@@ -376,6 +483,10 @@ class Indexer:
                 model_name,
                 tuple(pod_identifiers) if pod_identifiers else None,
             )
+        active_trace = current_trace()
+        ledger = self.cache_stats
+        sampled = ledger is not None and ledger.should_sample()
+        track_tiers = sampled and ledger.tier_detail_due()
         with obs_span("tokenize") as s:
             result = self.tokenization_pool.tokenize_with_keys(
                 prompt, model_name, render_req, self._key_space
@@ -393,7 +504,7 @@ class Indexer:
         pod_set = set(pod_identifiers) if pod_identifiers else None
 
         index = self.kv_block_index
-        if memo_key is not None and current_trace() is None:
+        if memo_key is not None and active_trace is None:
             # Exact-prompt score memo, validated optimistically: the
             # memoized result is served only when (1) tokenization
             # served the exact token stream the walk that computed it
@@ -415,6 +526,18 @@ class Indexer:
                 index.touch_chain(hit.touch_keys)
                 if self._record_chain_lookup is not None:
                     self._record_chain_lookup(0.0, hit.max_pod_hits)
+                if sampled:
+                    # Replay the elided walk's attribution so the
+                    # ledger's view is hit-path-independent (pinned by
+                    # the memo≡walk ledger test).
+                    _ledger_record(
+                        ledger,
+                        hit.family,
+                        model_name,
+                        total_blocks,
+                        hit.matched_blocks,
+                        hit.tier_counts,
+                    )
                 logger.debug(
                     "score-memo hit: %d pods over %d chain keys",
                     len(hit.scores),
@@ -423,7 +546,9 @@ class Indexer:
                 return dict(hit.scores)
         processor = self.token_processor
         scorer = self.scorer
-        chain = scorer.begin()
+        chain = scorer.begin(
+            track_tiers=track_tiers, track_deaths=active_trace is not None
+        )
         chunk_size = self._lookup_chunk
         perf = time.perf_counter
 
@@ -529,6 +654,36 @@ class Indexer:
         if record_lookup is not None:
             record_lookup(lookup_s, max_pod_hits)
 
+        if chain.deaths is not None and chain.active:
+            # The chain died by lookup truncation (the next key had no
+            # resident pods) rather than by scorer intersection; the
+            # surviving pods' break index is the first un-looked-up
+            # block — exactly where explain's full walk would break
+            # them (pinned by the provenance≡explain test).
+            if not alive:
+                for pod in chain.active:
+                    chain.deaths.setdefault(pod, chain.position)
+
+        family = None
+        if ledger is not None and (sampled or memo_key is not None):
+            # The family id must be lane- and memo-state-independent
+            # (one prompt, one family): an early exit can leave
+            # keys_done short of family_blocks (e.g. a dead 2-block
+            # memoized prefix), so hash the few missing prefix blocks
+            # before deriving it — bounded by family_blocks, and only
+            # on walks that died inside the family prefix.
+            need = min(ledger.config.family_blocks, total_blocks)
+            if len(keys_done) < need:
+                keys_done.extend(
+                    processor.extend_block_keys(
+                        keys_done[-1],
+                        tokens[
+                            len(keys_done) * block_size: need * block_size
+                        ],
+                        model_name,
+                    )
+                )
+            family = ledger.family_key(keys_done, total_blocks)
         if memo_key is not None:
             memo.put(
                 memo_key,
@@ -538,10 +693,26 @@ class Indexer:
                     tuple(tokens),
                     tuple(touched_keys),
                     max_pod_hits,
+                    family=family,
+                    matched_blocks=chain.matched_blocks,
+                    tier_counts=(
+                        dict(chain.tier_counts)
+                        if chain.tier_counts is not None
+                        else None
+                    ),
                 ),
             )
+        if sampled:
+            _ledger_record(
+                ledger,
+                family,
+                model_name,
+                total_blocks,
+                chain.matched_blocks,
+                chain.tier_counts,
+            )
 
-        tracer = current_trace()
+        tracer = active_trace
         if tracer is not None:
             # One span per pipeline stage (the stage vocabulary the
             # metrics histogram and the debug surface share), durations
@@ -560,6 +731,7 @@ class Indexer:
             span.set_attr("keys_hit", keys_hit)
             span = tracer.add_completed("score", end - score_s, end)
             span.set_attr("pods", len(chain.scores))
+            span.set_attr("provenance", _provenance_attr(chain))
         logger.debug(
             "fast-lane scored %d pods over %d/%d block keys "
             "(%d memoized)",
@@ -606,6 +778,38 @@ class Indexer:
         with obs_span("score") as s:
             per_pod = self.scorer.explain(block_keys, key_to_pods)
             s.set_attr("pods", len(per_pod))
+            s.set_attr(
+                "provenance",
+                {
+                    pod: {
+                        "blocks_matched": detail["blocks_matched"],
+                        "break_index": detail["break_index"],
+                    }
+                    for pod, detail in per_pod.items()
+                },
+            )
         explanation["pods"] = per_pod
         scores = {pod: detail["score"] for pod, detail in per_pod.items()}
+        ledger = self.cache_stats
+        if ledger is not None and ledger.should_sample():
+            # Explain requests are scoring requests too.  Attribution
+            # comes from the same ScoreChain drive the hot path uses
+            # (per-block best-resident-tier split, tier-sample gate
+            # included) — recording the best pod's OWN tiers here
+            # would feed the ledger a different split than the walk
+            # records for the identical request.
+            chain = self.scorer.begin(
+                track_tiers=ledger.tier_detail_due()
+            )
+            self.scorer.advance(
+                chain, [key_to_pods.get(key, ()) for key in block_keys]
+            )
+            _ledger_record(
+                ledger,
+                ledger.family_key(block_keys, len(block_keys)),
+                model_name,
+                len(block_keys),
+                chain.matched_blocks,
+                chain.tier_counts,
+            )
         return scores, explanation

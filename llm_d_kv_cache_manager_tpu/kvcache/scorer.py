@@ -13,7 +13,7 @@ keep scoring correctly.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Mapping, Sequence
+from typing import Dict, List, Mapping, Optional, Sequence
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
 
@@ -51,23 +51,68 @@ class ScoreChain:
     """Resumable longest-prefix scoring state (the fast lane's chunked
     drive): ``scores`` accumulates per-pod totals, ``active`` is the
     set of pods still alive on every consecutive block so far (``None``
-    until block 0 has been fed)."""
+    until block 0 has been fed).
 
-    __slots__ = ("scores", "active")
+    ``matched_blocks`` counts blocks on which at least one candidate
+    accrued — i.e. the best pod's consecutive matched-block count, the
+    analytics ledger's attribution input; always tracked (one integer
+    increment per block).  Two opt-in provenance modes cost only when
+    requested: ``track_tiers`` splits matched blocks by the best
+    resident tier per block (the ledger's per-tier hit split) and
+    ``track_deaths`` records each pod's chain-break index (the span
+    attrs a traced request carries, matching ``explain``'s
+    ``break_index`` exactly — both are pinned by property tests)."""
 
-    def __init__(self) -> None:
+    __slots__ = ("scores", "active", "matched_blocks", "position",
+                 "tier_counts", "deaths")
+
+    def __init__(
+        self, track_tiers: bool = False, track_deaths: bool = False
+    ) -> None:
         self.scores: Dict[str, float] = {}
         self.active = None  # type: ignore[assignment]
+        self.matched_blocks = 0
+        self.position = 0  # blocks examined (including a killing block)
+        self.tier_counts: Optional[Dict[str, int]] = (
+            {} if track_tiers else None
+        )
+        self.deaths: Optional[Dict[str, int]] = (
+            {} if track_deaths else None
+        )
 
     @property
     def alive(self) -> bool:
         """True while feeding more blocks could still change scores."""
         return self.active is None or bool(self.active)
 
+    def provenance(self) -> Dict[str, dict]:
+        """Per-pod ``{blocks_matched, break_index}`` for the walked
+        chain (requires ``track_deaths``): a pod that broke at block i
+        matched blocks 0..i-1; survivors matched every examined block
+        and carry ``break_index None`` — the same semantics as
+        ``LongestPrefixScorer.explain``."""
+        deaths = self.deaths if self.deaths is not None else {}
+        return {
+            pod: {
+                "blocks_matched": deaths.get(pod, self.matched_blocks),
+                "break_index": deaths.get(pod),
+            }
+            for pod in self.scores
+        }
+
 
 class LongestPrefixScorer:
     def __init__(self, tier_weights: Mapping[str, float]) -> None:
         self.tier_weights = dict(tier_weights)
+        # Canonical tier name per weight, first declaration wins: with
+        # the default table both "hbm" and its "gpu" alias weigh 1.0,
+        # and the ledger's per-tier split normalizes aliases to the
+        # canonical TPU names.  Unknown tiers resolve through the same
+        # 1.0 default the scoring loops use.
+        self._weight_to_tier: Dict[float, str] = {}
+        for name, weight in self.tier_weights.items():
+            self._weight_to_tier.setdefault(weight, name)
+        self._default_tier = self._weight_to_tier.get(1.0, "other")
         # Per-snapshot weight resolution, keyed on entry-tuple IDENTITY
         # (the in-memory index hands out one cached snapshot tuple per
         # pod cache until it mutates, so steady-state requests re-see
@@ -123,8 +168,12 @@ class LongestPrefixScorer:
                 best, tier = weight, entry.device_tier
         return best, tier
 
-    def begin(self) -> ScoreChain:
-        return ScoreChain()
+    def begin(
+        self, track_tiers: bool = False, track_deaths: bool = False
+    ) -> ScoreChain:
+        return ScoreChain(
+            track_tiers=track_tiers, track_deaths=track_deaths
+        )
 
     def advance(
         self,
@@ -144,6 +193,10 @@ class LongestPrefixScorer:
         scores = chain.scores
         active = chain.active
         resolve = self._resolve
+        tier_counts = chain.tier_counts
+        deaths = chain.deaths
+        weight_to_tier = self._weight_to_tier
+        default_tier = self._default_tier
         start = 0
         if active is None:
             if not pods_per_key:
@@ -161,29 +214,68 @@ class LongestPrefixScorer:
                 }
             scores.update(best)
             chain.active = active = set(best)
+            chain.position = 1
             if not active:
                 return False
+            chain.matched_blocks = 1
+            if tier_counts is not None:
+                tier = weight_to_tier.get(
+                    max(best.values()), default_tier
+                )
+                tier_counts[tier] = tier_counts.get(tier, 0) + 1
             start = 1
         elif not active:
             return False
         for index in range(start, len(pods_per_key)):
             pods = pods_per_key[index]
+            position = chain.position
+            chain.position = position + 1
             if not pods:
+                if deaths is not None:
+                    for pod in active:
+                        deaths[pod] = position
                 active.clear()
                 return False
             best = resolve(pods)
             best_keys = best.keys()
             if best_keys == active:
                 # Steady state: every active pod present — accrue.
-                for pod, weight in best.items():
-                    scores[pod] += weight
+                if tier_counts is None:
+                    for pod, weight in best.items():
+                        scores[pod] += weight
+                else:
+                    # Fused max: the accrue loop already visits every
+                    # weight, so tier attribution costs one compare per
+                    # pod, not a second pass.
+                    best_weight = 0.0
+                    for pod, weight in best.items():
+                        scores[pod] += weight
+                        if weight > best_weight:
+                            best_weight = weight
+                    tier = weight_to_tier.get(best_weight, default_tier)
+                    tier_counts[tier] = tier_counts.get(tier, 0) + 1
+                chain.matched_blocks += 1
                 continue
             survivors = active & best_keys
+            if deaths is not None:
+                for pod in active - survivors:
+                    deaths[pod] = position
             chain.active = active = survivors
             if not survivors:
                 return False
-            for pod in survivors:
-                scores[pod] += best[pod]
+            if tier_counts is None:
+                for pod in survivors:
+                    scores[pod] += best[pod]
+            else:
+                best_weight = 0.0
+                for pod in survivors:
+                    weight = best[pod]
+                    scores[pod] += weight
+                    if weight > best_weight:
+                        best_weight = weight
+                tier = weight_to_tier.get(best_weight, default_tier)
+                tier_counts[tier] = tier_counts.get(tier, 0) + 1
+            chain.matched_blocks += 1
         return True
 
     def score(

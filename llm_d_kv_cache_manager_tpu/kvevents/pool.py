@@ -78,7 +78,10 @@ from llm_d_kv_cache_manager_tpu.kvevents.events import (
     decode_event,
     decode_event_batch,
 )
-from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.metrics.collector import (
+    METRICS,
+    safe_label,
+)
 from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.obs.trace import (
     TRACER,
@@ -616,7 +619,7 @@ class Pool:
         for dropped, reason in shed:
             METRICS.kvevents_dropped.labels(reason=reason).inc()
             METRICS.kvevents_pod_shed.labels(
-                pod=dropped.pod_identifier
+                pod=safe_label(dropped.pod_identifier)
             ).inc()
             self._finish_dropped(dropped, reason)
             logger.debug(
@@ -626,7 +629,7 @@ class Pool:
             )
         if depth >= 0:
             METRICS.kvevents_pod_backlog.labels(
-                pod=message.pod_identifier
+                pod=safe_label(message.pod_identifier)
             ).set(depth)
 
     def enqueue_resync(self, job: ResyncJob, trace_: Optional[Trace] = None):
@@ -657,7 +660,9 @@ class Pool:
                 return
             for pod, depth in depths.items():
                 if pod:
-                    METRICS.kvevents_pod_backlog.labels(pod=pod).set(depth)
+                    METRICS.kvevents_pod_backlog.labels(
+                        pod=safe_label(pod)
+                    ).set(depth)
             try:
                 self._process_batch(batch, worker_index)
             except Exception:

@@ -39,7 +39,10 @@ from typing import Callable, Dict, Optional
 import zmq
 
 from llm_d_kv_cache_manager_tpu.kvevents.pool import Message
-from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.metrics.collector import (
+    METRICS,
+    safe_label,
+)
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger, trace
 
 logger = get_logger("kvevents.zmq")
@@ -192,7 +195,9 @@ def parse_event_message(
                 trace(logger, "duplicate seq %d on %s; dropping", seq, topic)
                 return None
             if observed.restarted:
-                METRICS.kvevents_publisher_restarts.labels(pod=pod_id).inc()
+                METRICS.kvevents_publisher_restarts.labels(
+                    pod=safe_label(pod_id)
+                ).inc()
                 logger.info(
                     "publisher restart on %s: counter reset to %d "
                     "(watermark reset, not counted as a gap)",
@@ -201,7 +206,7 @@ def parse_event_message(
                 )
             elif observed.gap:
                 gap = observed.gap
-                METRICS.kvevents_seq_gaps.labels(pod=pod_id).inc(gap)
+                METRICS.kvevents_seq_gaps.labels(pod=safe_label(pod_id)).inc(gap)
                 logger.warning(
                     "sequence gap on %s: -> %d (%d events lost)",
                     topic,

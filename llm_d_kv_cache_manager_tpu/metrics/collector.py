@@ -207,6 +207,62 @@ class KVCacheMetrics:
             ("direction", "status"),
             registry=self.registry,
         )
+        # Cache-efficiency analytics (analytics/ledger.py): per-request
+        # hit attribution on the scoring read path.  At
+        # CACHESTATS_SAMPLE_RATE < 1 these are an unbiased sample of
+        # the request mix, not a total count (same caveat as
+        # stage_latency below).
+        self.cachestats_requests = Counter(
+            f"{_NAMESPACE}_cachestats_requests_total",
+            "Scored requests recorded by the hit-attribution ledger, by "
+            "outcome (hit: best pod covered >= hit_ratio of the prompt's "
+            "block chain; partial: anything matched; miss: nothing).",
+            ("outcome",),
+            registry=self.registry,
+        )
+        self.cachestats_tier_hits = Counter(
+            f"{_NAMESPACE}_cachestats_tier_hits_total",
+            "Scored blocks attributed to each memory tier (the best "
+            "resident tier per consecutive matched block).",
+            ("tier",),
+            registry=self.registry,
+        )
+        self.cachestats_reuse_distance = Histogram(
+            f"{_NAMESPACE}_cachestats_reuse_distance",
+            "Distinct scored requests between re-encounters of a prefix "
+            "family (working-set reuse distance).",
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096,
+                     16384),
+        )
+        self.cachestats_families = Gauge(
+            f"{_NAMESPACE}_cachestats_families",
+            "Prefix families currently tracked, summed across ledger "
+            "instances (each LRU-bounded by CACHESTATS_MAX_FAMILIES); "
+            "maintained by deltas so several ledgers aggregate.",
+            registry=self.registry,
+        )
+        # Index-truth audit plane (analytics/auditor.py).
+        self.index_divergence_ratio = Gauge(
+            f"{_NAMESPACE}_index_divergence_ratio",
+            "Per-pod index-vs-inventory divergence from the last audit: "
+            "(phantom + missing + wrong-tier blocks) / union size.",
+            ("pod",),
+            registry=self.registry,
+        )
+        self.index_audits = Counter(
+            f"{_NAMESPACE}_index_audits_total",
+            "Pod audits by outcome (clean / divergent / failed).",
+            ("outcome",),
+            registry=self.registry,
+        )
+        self.index_audit_blocks = Counter(
+            f"{_NAMESPACE}_index_audit_blocks_total",
+            "Divergent blocks found by audits, by kind (phantom / "
+            "missing / wrong_tier).",
+            ("kind",),
+            registry=self.registry,
+        )
         # Per-stage latencies fed by the tracing subsystem (obs/trace.py):
         # every span of a sampled trace lands here under its span name, so
         # the aggregate view and the per-request flight-recorder view
@@ -232,6 +288,33 @@ class KVCacheMetrics:
 # Process-wide default instance; modules import this rather than plumbing a
 # registry through every constructor.
 METRICS = KVCacheMetrics()
+
+# Label values longer than this are truncated (with a marker) before
+# reaching the registry: label values are unbounded wire input in the
+# pod-labeled families, and a single hostile topic string must not blow
+# up every scrape.
+MAX_LABEL_LEN = 120
+
+
+def safe_label(value: str) -> str:
+    """Bound and sanitize a wire-sourced label value.
+
+    The exposition format itself escapes ``\\``, ``\"`` and newlines
+    (prometheus_client does this on output; pinned by
+    tests/test_metrics_endpoint.py) — this helper handles what escaping
+    cannot: unbounded length and non-printable control characters in
+    values that arrive from the network (pod identifiers parsed out of
+    ZMQ topics).  Printable text passes through unchanged, so normal
+    pod names keep their exact label identity.
+    """
+    text = str(value)
+    if any(ch < " " or ch == "\x7f" for ch in text):
+        text = "".join(
+            ch if ch >= " " and ch != "\x7f" else "�" for ch in text
+        )
+    if len(text) > MAX_LABEL_LEN:
+        text = text[: MAX_LABEL_LEN - 1] + "…"
+    return text
 
 
 def counter_total(counter: Counter) -> float:

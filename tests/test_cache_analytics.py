@@ -1,0 +1,1033 @@
+"""Cache-efficiency analytics: ledger, windows, auditor, debug surface.
+
+Covers the tentpole's acceptance properties:
+
+* window frames rotate exactly at their boundaries (injected clock)
+  and serialize to canonical CBOR that round-trips;
+* the reuse inter-arrival EWMA tracks bursty arrivals;
+* divergence math on synthetic phantom / missing / wrong-tier
+  inventories, incl. parent-chain resolution through engine hashes;
+* ledger ≡ explain: the hot path's attribution (matched blocks, tier
+  split) equals the explain surface's, and the score-memo replay
+  records exactly what the elided walk would have;
+* traced requests carry per-pod blocks_matched/break_index span attrs
+  that match explain (the /debug/traces cross-link satellite);
+* scores are bit-identical with analytics on vs off;
+* the /debug/cachestats endpoint end to end (totals, drill-down,
+  audit log) and the /healthz analytics block;
+* bounded memory: the family table LRU-evicts at max_families;
+* concurrent records against snapshots never lose counts.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import tempfile
+import threading
+import urllib.request
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.analytics.auditor import (
+    AuditorConfig,
+    IndexAuditor,
+)
+from llm_d_kv_cache_manager_tpu.analytics.ledger import (
+    CacheStatsLedger,
+    LedgerConfig,
+)
+from llm_d_kv_cache_manager_tpu.analytics.windows import (
+    Frame,
+    WindowRing,
+)
+from llm_d_kv_cache_manager_tpu.api.http_service import serve
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cbor_canonical import (
+    decode_canonical,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.resync import (
+    CallableInventorySource,
+    InventoryBlock,
+    PodInventory,
+)
+from llm_d_kv_cache_manager_tpu.obs.trace import TRACER, use_trace
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import Encoding
+
+MODEL = "analytics-model"
+BLOCK_SIZE = 4
+
+
+class WordTokenizer:
+    """Deterministic whitespace tokenizer: 'tN' -> N."""
+
+    def type(self) -> str:
+        return "word"
+
+    def encode(self, prompt, model_name, add_special_tokens=True):
+        tokens, offsets, pos = [], [], 0
+        for word in prompt.split(" "):
+            tokens.append(int(word[1:]))
+            offsets.append((pos, pos + len(word)))
+            pos += len(word) + 1
+        return Encoding(tokens, offsets)
+
+
+def make_indexer(
+    fast=True, ledger=None, cache_stats=None, memo=True
+) -> Indexer:
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE
+            ),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                workers=1, model_name=MODEL
+            ),
+            read_path_fast_lane=fast,
+            score_memo_size=None if memo else 0,
+            cache_stats=cache_stats,
+        ),
+        tokenizer=WordTokenizer(),
+        cache_stats_ledger=ledger,
+    )
+    indexer.run()
+    return indexer
+
+
+def prompt_of(tokens) -> str:
+    return " ".join(f"t{t}" for t in tokens)
+
+
+def seed_chain(indexer, tokens, pod, tier, blocks=None):
+    keys = indexer.token_processor.tokens_to_kv_block_keys(
+        0, tokens, MODEL
+    )
+    if blocks is not None:
+        keys = keys[:blocks]
+    indexer.kv_block_index.add(keys, keys, [PodEntry(pod, tier)])
+    return keys
+
+
+# ----------------------------- windows ---------------------------------
+
+
+class TestWindows:
+    def test_frame_rotation_at_boundaries(self):
+        ring = WindowRing(span_s=5.0, frames=3)  # 15s window
+        ring.record(0.0, "hit", 4, 4)
+        ring.record(4.999, "miss", 0, 4)  # same frame
+        ring.record(5.0, "hit", 4, 4)  # next frame, exactly on edge
+        ring.record(10.0, "partial", 2, 4)
+        assert ring.totals(10.0)["requests"] == 4
+        # 15.0 pushes the window floor past slot 0: its 2 records drop.
+        totals = ring.totals(15.0)
+        assert totals["requests"] == 2
+        assert totals["hits"] == 1 and totals["partials"] == 1
+        # Far future: everything rotated out; ring stays bounded.
+        empty = ring.totals(1000.0)
+        assert empty["requests"] == 0 and empty["hit_rate"] is None
+        assert len(ring.live_frames(1000.0)) == 0
+
+    def test_ring_never_exceeds_frame_count(self):
+        ring = WindowRing(span_s=1.0, frames=4)
+        for second in range(100):
+            ring.record(float(second), "hit", 1, 1)
+        assert len(ring.live_frames(99.0)) <= 4
+
+    def test_cbor_frames_round_trip(self):
+        ring = WindowRing(span_s=5.0, frames=2)
+        ring.record(1.0, "hit", 3, 4, {"hbm": 2, "host": 1})
+        ring.record(6.0, "miss", 0, 4)
+        version, span_ms, frames, payload = decode_canonical(
+            ring.to_cbor(6.0)
+        )
+        assert version == 1 and span_ms == 5000 and frames == 2
+        assert len(payload) == 2
+        slot, requests, hits, partials, misses, matched, total, tiers = (
+            payload[0]
+        )
+        assert (requests, hits, misses) == (1, 1, 0)
+        assert tiers == [["hbm", 2], ["host", 1]]
+        # Canonical: equal counts encode to equal bytes.
+        assert ring.to_cbor(6.0) == ring.to_cbor(6.0)
+
+    def test_frame_merge_absorbs_counts(self):
+        a = Frame(7)
+        a.record("hit", 4, 4, {"hbm": 4})
+        b = Frame(7)
+        b.record("partial", 2, 4, {"host": 2})
+        b.merge(a)
+        assert b.requests == 2 and b.hits == 1 and b.partials == 1
+        assert b.tiers == {"host": 2, "hbm": 4}
+
+
+# ----------------------------- ledger ----------------------------------
+
+
+class TestLedger:
+    def test_classification_thresholds(self):
+        ledger = CacheStatsLedger(LedgerConfig(hit_ratio=1.0))
+        assert ledger.classify(10, 10) == "hit"
+        assert ledger.classify(9, 10) == "partial"
+        assert ledger.classify(0, 10) == "miss"
+        ratio = CacheStatsLedger(LedgerConfig(hit_ratio=0.5))
+        assert ratio.classify(5, 10) == "hit"
+        assert ratio.classify(4, 10) == "partial"
+        absolute = CacheStatsLedger(LedgerConfig(hit_blocks=512))
+        assert absolute.classify(512, 528) == "hit"
+        assert absolute.classify(511, 528) == "partial"
+
+    def test_ewma_under_bursty_arrivals(self):
+        ledger = CacheStatsLedger(LedgerConfig())
+        family = 0xF00
+        # A burst of 1s-spaced arrivals...
+        now = 100.0
+        for _ in range(8):
+            ledger.record(family, MODEL, 4, 4, None, now=now)
+            now += 1.0
+        ewma_burst = ledger.predicted_interarrival_s(family)
+        assert 0.9 <= ewma_burst <= 1.1
+        # ...then a long gap pulls the EWMA up, but smoothed (alpha
+        # 0.3: one 61s gap from ~1s lands at ~0.3*61 + 0.7*1).
+        now += 60.0
+        ledger.record(family, MODEL, 4, 4, None, now=now)
+        ewma_after_gap = ledger.predicted_interarrival_s(family)
+        assert 15.0 <= ewma_after_gap <= 25.0
+        # Resumed fast arrivals decay it back down.
+        for _ in range(12):
+            now += 0.5
+            ledger.record(family, MODEL, 4, 4, None, now=now)
+        assert ledger.predicted_interarrival_s(family) < 2.0
+
+    def test_reuse_distance_histogram_and_flush_parity(self):
+        from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+        before = {}
+        for metric in METRICS.cachestats_reuse_distance.collect():
+            for sample in metric.samples:
+                before[
+                    (sample.name, tuple(sorted(sample.labels.items())))
+                ] = sample.value
+        ledger = CacheStatsLedger(LedgerConfig())
+        # Distances: family B seen after 1 other request (distance 2),
+        # then repeats at distance 2 each; family A repeats at 2 too.
+        for _ in range(10):
+            ledger.record(0xA, MODEL, 4, 4, None, now=1.0)
+            ledger.record(0xB, MODEL, 4, 4, None, now=1.0)
+        ledger.flush_metrics()
+        snapshot = ledger.snapshot(now=1.0)
+        assert snapshot["reuse_distance"] == {"le_2": 18}
+        after = {}
+        total = 0.0
+        for metric in METRICS.cachestats_reuse_distance.collect():
+            for sample in metric.samples:
+                key = (
+                    sample.name,
+                    tuple(sorted(sample.labels.items())),
+                )
+                delta = sample.value - before.get(key, 0.0)
+                if sample.name.endswith("_count"):
+                    total = delta
+                if sample.name.endswith("_bucket") and delta:
+                    after[dict(sample.labels)["le"]] = delta
+        assert total == 18.0
+        # All 18 observations landed in the le=2 bucket (cumulative
+        # buckets: every bound >= 2 carries them).
+        assert after.get("2.0") == 18.0
+
+    def test_family_table_bounded_with_lru_eviction(self):
+        ledger = CacheStatsLedger(
+            LedgerConfig(max_families=8, stripes=1)
+        )
+        for family in range(16):
+            ledger.record(family, MODEL, 4, 4, None, now=1.0)
+        assert ledger.families_tracked() == 8
+        # Touch family 8 (move-to-end), then insert a new one: the
+        # evicted family must be 9 (LRU), not 8.
+        ledger.record(8, MODEL, 4, 4, None, now=2.0)
+        ledger.record(999, MODEL, 4, 4, None, now=3.0)
+        assert ledger.family_detail(8) is not None
+        assert ledger.family_detail(9) is None
+        snapshot = ledger.snapshot(now=3.0)
+        assert snapshot["totals"]["families_evicted"] >= 9
+
+    def test_sample_rate_zero_records_nothing(self):
+        ledger = CacheStatsLedger(LedgerConfig(sample_rate=0.0))
+        assert not ledger.should_sample()
+
+    def test_tier_sample_gate(self):
+        ledger = CacheStatsLedger(LedgerConfig(tier_sample=4))
+        due = [ledger.tier_detail_due() for _ in range(8)]
+        assert due.count(True) == 2
+        always = CacheStatsLedger(LedgerConfig(tier_sample=1))
+        assert all(always.tier_detail_due() for _ in range(5))
+
+    def test_concurrent_records_lose_nothing(self):
+        ledger = CacheStatsLedger(LedgerConfig(max_families=1024))
+        n_threads, per_thread = 8, 500
+        barrier = threading.Barrier(n_threads)
+
+        def worker(tid):
+            barrier.wait()
+            for i in range(per_thread):
+                ledger.record(
+                    (tid << 16) | (i % 32), MODEL, 8, 8 if i % 2 else 0,
+                    {"hbm": 8} if i % 2 else None,
+                )
+                if i % 100 == 0:
+                    ledger.snapshot()
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        totals = ledger.snapshot()["totals"]
+        assert totals["recorded"] == n_threads * per_thread
+        assert totals["hits"] + totals["partials"] + totals["misses"] == (
+            n_threads * per_thread
+        )
+
+
+# ------------------------ ledger ≡ read path ---------------------------
+
+
+class TestLedgerReadPathConsistency:
+    @pytest.fixture()
+    def rng(self):
+        return random.Random(1234)
+
+    def _run_pair(self, indexer, prompt, pods):
+        """Score via the hot path, then explain; return (ledger record
+        deltas, explain detail)."""
+        ledger = indexer.cache_stats
+        before = ledger.snapshot()["totals"]
+        scores = indexer.get_pod_scores(prompt, MODEL, pods)
+        after = ledger.snapshot()["totals"]
+        explain_scores, detail = indexer.get_pod_scores_explained(
+            prompt, MODEL, pods
+        )
+        assert scores == explain_scores
+        delta_matched = after["blocks_matched"] - before["blocks_matched"]
+        delta_tiers = {
+            tier: after["tiers"].get(tier, 0)
+            - before["tiers"].get(tier, 0)
+            for tier in set(after["tiers"]) | set(before["tiers"])
+        }
+        delta_tiers = {k: v for k, v in delta_tiers.items() if v}
+        return delta_matched, delta_tiers, detail
+
+    def test_ledger_matches_explain_property(self, rng):
+        """Randomized: residency prefixes of random lengths on random
+        tiers — the hot path's recorded matched blocks and tier split
+        must equal explain's best pod."""
+        ledger = CacheStatsLedger(
+            LedgerConfig(sample_rate=1.0, tier_sample=1)
+        )
+        indexer = make_indexer(ledger=ledger, memo=False)
+        try:
+            for trial in range(12):
+                tokens = [
+                    rng.randrange(1, 500) for _ in range(BLOCK_SIZE * 12)
+                ]
+                tier = rng.choice(["hbm", "host", "shared_storage"])
+                blocks = rng.randrange(0, 13)
+                if blocks:
+                    seed_chain(
+                        indexer, tokens, f"pod-{trial}", tier, blocks
+                    )
+                matched, tiers, detail = self._run_pair(
+                    indexer, prompt_of(tokens), None
+                )
+                per_pod = detail["pods"]
+                best = (
+                    max(
+                        d["blocks_matched"] for d in per_pod.values()
+                    )
+                    if per_pod
+                    else 0
+                )
+                assert matched == best == blocks
+                if blocks:
+                    assert tiers == {tier: blocks}, (trial, tiers)
+        finally:
+            indexer.shutdown()
+
+    def test_memo_replay_records_like_the_walk(self):
+        """Exact-repeat requests served from the score memo must feed
+        the ledger the same attribution the walk did."""
+        ledger = CacheStatsLedger(
+            LedgerConfig(sample_rate=1.0, tier_sample=1)
+        )
+        indexer = make_indexer(ledger=ledger, memo=True)
+        try:
+            tokens = [7 + i for i in range(BLOCK_SIZE * 8)]
+            seed_chain(indexer, tokens, "pod-m", "host", 5)
+            prompt = prompt_of(tokens)
+            first = indexer.get_pod_scores(prompt, MODEL, ["pod-m"])
+            t0 = ledger.snapshot()["totals"]
+            for _ in range(3):  # memo hits
+                assert (
+                    indexer.get_pod_scores(prompt, MODEL, ["pod-m"])
+                    == first
+                )
+            t1 = ledger.snapshot()["totals"]
+            assert t1["recorded"] - t0["recorded"] == 3
+            assert t1["blocks_matched"] - t0["blocks_matched"] == 15
+            assert t1["tiers"]["host"] - t0["tiers"].get("host", 0) == 15
+            # One family throughout, with reuse arrivals tracked.
+            top = ledger.top_families()
+            assert len(top) == 1 and top[0]["requests"] == 4
+            assert top[0]["ewma_interarrival_s"] is not None
+        finally:
+            indexer.shutdown()
+
+    def test_scores_identical_analytics_on_vs_off(self, rng):
+        on_ledger = CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+        on = make_indexer(ledger=on_ledger)
+        off = make_indexer(cache_stats=False)
+        try:
+            assert off.cache_stats is None
+            tokens = [
+                rng.randrange(1, 300) for _ in range(BLOCK_SIZE * 16)
+            ]
+            for target in (on, off):
+                seed_chain(target, tokens, "pod-x", "hbm", 9)
+                seed_chain(target, tokens, "pod-y", "host", 4)
+            for _ in range(3):
+                prompt = prompt_of(tokens)
+                assert on.get_pod_scores(
+                    prompt, MODEL, ["pod-x", "pod-y"]
+                ) == off.get_pod_scores(prompt, MODEL, ["pod-x", "pod-y"])
+        finally:
+            on.shutdown()
+            off.shutdown()
+
+    def test_straight_lane_records_too(self):
+        ledger = CacheStatsLedger(
+            LedgerConfig(sample_rate=1.0, tier_sample=1)
+        )
+        indexer = make_indexer(fast=False, ledger=ledger)
+        try:
+            tokens = [11 + i for i in range(BLOCK_SIZE * 6)]
+            seed_chain(indexer, tokens, "pod-s", "hbm", 6)
+            indexer.get_pod_scores(prompt_of(tokens), MODEL, ["pod-s"])
+            totals = ledger.snapshot()["totals"]
+            assert totals["recorded"] == 1
+            assert totals["hits"] == 1
+            assert totals["tiers"] == {"hbm": 6}
+        finally:
+            indexer.shutdown()
+
+
+class TestReviewRegressions:
+    """Pins for the review-pass fixes."""
+
+    def test_family_stable_across_early_exit_and_lanes(self):
+        """A dead 2-block memoized prefix must not fragment the family
+        id: the fast lane's early exit leaves keys_done short of
+        family_blocks, and the family must still be the one the full
+        chain defines (same id the straight lane computes)."""
+        ledger = CacheStatsLedger(
+            LedgerConfig(sample_rate=1.0, family_blocks=4)
+        )
+        indexer = make_indexer(ledger=ledger)
+        try:
+            base_tokens = [100 + i for i in range(BLOCK_SIZE * 2)]
+            long_tokens = base_tokens + [
+                300 + i for i in range(BLOCK_SIZE * 6)
+            ]
+            # Score the 2-block prompt first: the prefix store memoizes
+            # exactly 2 chain keys, so the longer prompt's walk starts
+            # from a 2-key memo chunk and dies there (cold index).
+            indexer.get_pod_scores(prompt_of(base_tokens), MODEL, None)
+            indexer.get_pod_scores(prompt_of(long_tokens), MODEL, None)
+            full_keys = indexer.token_processor.tokens_to_kv_block_keys(
+                0, long_tokens, MODEL
+            )
+            expected = f"{full_keys[3]:016x}"
+            families = {f["family"] for f in ledger.top_families()}
+            assert expected in families, (expected, families)
+            # The memo replay uses the same id: repeat and re-check the
+            # family's request count moved (not a new fragment).
+            indexer.get_pod_scores(prompt_of(long_tokens), MODEL, None)
+            detail = ledger.family_detail(full_keys[3])
+            assert detail is not None and detail["requests"] == 2
+        finally:
+            indexer.shutdown()
+
+    def test_explain_path_records_hot_path_tier_split(self):
+        """?explain=1 requests must feed the ledger the same per-block
+        best-resident-tier split the walk records — not the best pod's
+        own tiers."""
+        ledger = CacheStatsLedger(
+            LedgerConfig(sample_rate=1.0, tier_sample=1)
+        )
+        indexer = make_indexer(ledger=ledger, memo=False)
+        try:
+            tokens = [40 + i for i in range(BLOCK_SIZE * 5)]
+            # pod-a: 5 blocks on host; pod-b: first 3 on hbm.  Best
+            # tier per block: hbm,hbm,hbm,host,host.
+            seed_chain(indexer, tokens, "pod-a", "host", 5)
+            seed_chain(indexer, tokens, "pod-b", "hbm", 3)
+            prompt = prompt_of(tokens)
+            before = ledger.snapshot()["totals"]["tiers"]
+            indexer.get_pod_scores(prompt, MODEL, None)
+            mid = ledger.snapshot()["totals"]["tiers"]
+            walk_split = {
+                tier: mid.get(tier, 0) - before.get(tier, 0)
+                for tier in set(mid) | set(before)
+            }
+            walk_split = {k: v for k, v in walk_split.items() if v}
+            assert walk_split == {"hbm": 3, "host": 2}
+            indexer.get_pod_scores_explained(prompt, MODEL, None)
+            after = ledger.snapshot()["totals"]["tiers"]
+            explain_split = {
+                tier: after.get(tier, 0) - mid.get(tier, 0)
+                for tier in set(after) | set(mid)
+            }
+            explain_split = {
+                k: v for k, v in explain_split.items() if v
+            }
+            assert explain_split == walk_split
+        finally:
+            indexer.shutdown()
+
+    def test_auditor_prunes_departed_pods(self):
+        index = TestAuditor()._index()
+        pod = SyntheticPod(index, "p0", 10)
+        auditor = IndexAuditor(
+            index,
+            processor(),
+            CallableInventorySource(lambda p: pod.inventory(drop_last=2)),
+            AuditorConfig(interval_s=0.0),
+        )
+        auditor.run_cycle()
+        assert auditor.status()["divergent_pods"] == {"p0": 0.2}
+        index.purge_pod("p0")
+        auditor.run_cycle()
+        status = auditor.status()
+        assert status["divergent_pods"] == {}
+        assert status["pods_tracked"] == 0
+
+    def test_healthz_survives_analytics_failure(self):
+        indexer = make_indexer(
+            ledger=CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+        )
+        server = serve(indexer, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            def boom():
+                raise RuntimeError("analytics bug")
+
+            indexer.cache_stats.stats_summary = boom
+            status, health = http_json(base, "/healthz")
+            assert status == 200
+            assert health["status"] == "ok"
+            assert health["analytics"] == {"error": "unavailable"}
+        finally:
+            server.shutdown()
+            indexer.shutdown()
+
+    def test_env_sample_rate_out_of_range_warns_and_defaults(self, monkeypatch):
+        monkeypatch.setenv("CACHESTATS_SAMPLE_RATE", "2.5")
+        config = LedgerConfig.from_env()
+        assert config.sample_rate == 1.0
+        # The Indexer construction path must survive the typo'd knob.
+        CacheStatsLedger(config)
+
+    def test_multi_tier_inventory_not_order_dependent(self):
+        """A pod holding one block on two tiers must audit identically
+        regardless of inventory block ordering (tier sets, not
+        last-write-wins strings)."""
+        index = TestAuditor()._index()
+        pod = SyntheticPod(index, "p0", 6, tier="hbm")
+
+        def two_tier_inventory(order):
+            blocks = [
+                InventoryBlock(
+                    block_hashes=list(pod.engine_hashes),
+                    token_ids=list(pod.tokens),
+                    block_size=BLOCK_SIZE,
+                    medium=tier,
+                )
+                for tier in order
+            ]
+            return PodInventory(
+                pod_identifier="p0", model_name=MODEL, blocks=blocks
+            )
+
+        for order in (["hbm", "host"], ["host", "hbm"]):
+            auditor = IndexAuditor(
+                index,
+                processor(),
+                CallableInventorySource(
+                    lambda p, o=order: two_tier_inventory(o)
+                ),
+                AuditorConfig(interval_s=0.0),
+            )
+            report = auditor.audit_pod("p0")
+            assert report.outcome == "clean", (order, report.to_dict())
+
+    def test_ledger_close_returns_families_to_gauge(self):
+        from llm_d_kv_cache_manager_tpu.metrics.collector import (
+            METRICS,
+            gauge_value,
+        )
+
+        before = gauge_value(METRICS.cachestats_families)
+        indexer = make_indexer()  # constructs and owns its ledger
+        try:
+            ledger = indexer.cache_stats
+            for i in range(6):
+                ledger.record(0x7000 + i, MODEL, 4, 4, None, now=1.0)
+            assert gauge_value(METRICS.cachestats_families) == before + 6
+        finally:
+            indexer.shutdown()
+        assert gauge_value(METRICS.cachestats_families) == before
+        ledger.close()  # idempotent
+        assert gauge_value(METRICS.cachestats_families) == before
+
+    def test_injected_ledger_survives_indexer_shutdown(self):
+        ledger = CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+        indexer = make_indexer(ledger=ledger)
+        indexer.shutdown()
+        # Caller-owned: still records after the indexer is gone.
+        ledger.record(0x1, MODEL, 4, 4, None, now=1.0)
+        assert ledger.snapshot()["totals"]["recorded"] == 1
+
+    def test_families_gauge_aggregates_across_ledgers(self):
+        from llm_d_kv_cache_manager_tpu.metrics.collector import (
+            METRICS,
+            gauge_value,
+        )
+
+        before = gauge_value(METRICS.cachestats_families)
+        a = CacheStatsLedger(LedgerConfig(max_families=64))
+        b = CacheStatsLedger(LedgerConfig(max_families=64))
+        for i in range(5):
+            a.record(0x1000 + i, MODEL, 4, 4, None, now=1.0)
+        for i in range(3):
+            b.record(0x2000 + i, MODEL, 4, 4, None, now=1.0)
+        assert gauge_value(METRICS.cachestats_families) == before + 8
+        # Repeats are not new families; eviction nets insert to zero.
+        a.record(0x1000, MODEL, 4, 4, None, now=2.0)
+        assert gauge_value(METRICS.cachestats_families) == before + 8
+
+
+# ------------------------- trace provenance ----------------------------
+
+
+class TestTraceProvenance:
+    def _traced_score(self, indexer, prompt, pods):
+        trace = TRACER.start_trace("test.score", force=True)
+        with use_trace(trace):
+            scores = indexer.get_pod_scores(prompt, MODEL, pods)
+        trace.finish()
+        provenance = None
+        for span in trace.to_dict()["spans"]:
+            if span["name"] == "score":
+                provenance = span["attributes"].get("provenance")
+        return scores, provenance
+
+    @pytest.mark.parametrize("fast", [True, False])
+    def test_span_provenance_matches_explain(self, fast):
+        """The /debug/traces cross-link: a traced scoring request's
+        score span carries per-pod blocks_matched/break_index equal to
+        explain's (both lanes, incl. the fast lane's early-exit
+        truncation where the break is the first un-looked-up block)."""
+        indexer = make_indexer(fast=fast, cache_stats=False)
+        try:
+            tokens = [3 + i for i in range(BLOCK_SIZE * 10)]
+            seed_chain(indexer, tokens, "pod-a", "hbm", 7)
+            seed_chain(indexer, tokens, "pod-b", "host", 3)
+            prompt = prompt_of(tokens)
+            scores, provenance = self._traced_score(
+                indexer, prompt, ["pod-a", "pod-b"]
+            )
+            _, detail = indexer.get_pod_scores_explained(
+                prompt, MODEL, ["pod-a", "pod-b"]
+            )
+            assert provenance is not None
+            expected = {
+                pod: {
+                    "blocks_matched": d["blocks_matched"],
+                    "break_index": d["break_index"],
+                }
+                for pod, d in detail["pods"].items()
+            }
+            assert provenance == expected
+            assert provenance["pod-a"]["break_index"] == 7
+            assert provenance["pod-b"]["break_index"] == 3
+        finally:
+            indexer.shutdown()
+
+    def test_survivor_has_null_break_index(self):
+        indexer = make_indexer(cache_stats=False)
+        try:
+            tokens = [5 + i for i in range(BLOCK_SIZE * 6)]
+            seed_chain(indexer, tokens, "pod-full", "hbm")  # whole chain
+            _, provenance = self._traced_score(
+                indexer, prompt_of(tokens), ["pod-full"]
+            )
+            assert provenance["pod-full"] == {
+                "blocks_matched": 6,
+                "break_index": None,
+            }
+        finally:
+            indexer.shutdown()
+
+
+# ------------------------------ auditor --------------------------------
+
+
+def processor():
+    return ChunkedTokenDatabase(
+        TokenProcessorConfig(block_size=BLOCK_SIZE)
+    )
+
+
+class SyntheticPod:
+    """Builds an index + matching inventory for one pod."""
+
+    def __init__(self, index, pod, n_blocks, tier="hbm", seed=0):
+        rng = random.Random(seed)
+        self.pod = pod
+        self.tier = tier
+        self.proc = processor()
+        self.tokens = [
+            rng.randrange(1, 1000) for _ in range(n_blocks * BLOCK_SIZE)
+        ]
+        self.request_keys = self.proc.tokens_to_kv_block_keys(
+            0, self.tokens, MODEL
+        )
+        # Engine hashes differ from request keys (distinct hash scheme).
+        self.engine_hashes = [k ^ 0xDEAD for k in self.request_keys]
+        index.add(
+            self.engine_hashes,
+            self.request_keys,
+            [PodEntry(pod, tier)],
+        )
+
+    def inventory(self, drop_last=0, tier=None):
+        keep = len(self.engine_hashes) - drop_last
+        return PodInventory(
+            pod_identifier=self.pod,
+            model_name=MODEL,
+            blocks=[
+                InventoryBlock(
+                    block_hashes=self.engine_hashes[:keep],
+                    token_ids=self.tokens[: keep * BLOCK_SIZE],
+                    block_size=BLOCK_SIZE,
+                    medium=tier or self.tier,
+                )
+            ],
+        )
+
+
+class TestAuditor:
+    def _auditor(self, index, fetch, **config):
+        return IndexAuditor(
+            index,
+            processor(),
+            CallableInventorySource(fetch),
+            AuditorConfig(interval_s=0.0, **config),
+        )
+
+    def _index(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+            InMemoryIndex,
+        )
+
+        return InMemoryIndex()
+
+    def test_clean_pod_is_clean(self):
+        index = self._index()
+        pod = SyntheticPod(index, "p0", 20)
+        auditor = self._auditor(index, lambda p: pod.inventory())
+        report = auditor.audit_pod("p0")
+        assert report.outcome == "clean"
+        assert report.divergence_ratio == 0.0
+        assert report.index_claims == 20
+        assert report.inventory_blocks == 20
+
+    def test_phantom_blocks_detected(self):
+        index = self._index()
+        pod = SyntheticPod(index, "p0", 40)
+        auditor = self._auditor(
+            index, lambda p: pod.inventory(drop_last=4)
+        )
+        report = auditor.audit_pod("p0")
+        assert report.outcome == "divergent"
+        assert report.phantom == 4 and report.missing == 0
+        assert report.divergence_ratio == pytest.approx(4 / 40)
+        assert len(report.phantom_sample) == 4
+
+    def test_missing_blocks_detected(self):
+        index = self._index()
+        pod = SyntheticPod(index, "p0", 30)
+        # The index "lost" the last 6 blocks: purge and re-add a prefix.
+        index.purge_pod("p0")
+        index.add(
+            pod.engine_hashes[:24],
+            pod.request_keys[:24],
+            [PodEntry("p0", "hbm")],
+        )
+        auditor = self._auditor(index, lambda p: pod.inventory())
+        report = auditor.audit_pod("p0")
+        assert report.outcome == "divergent"
+        assert report.missing == 6 and report.phantom == 0
+        assert report.divergence_ratio == pytest.approx(6 / 30)
+
+    def test_wrong_tier_detected(self):
+        index = self._index()
+        pod = SyntheticPod(index, "p0", 10, tier="hbm")
+        auditor = self._auditor(
+            index, lambda p: pod.inventory(tier="host")
+        )
+        report = auditor.audit_pod("p0")
+        assert report.outcome == "divergent"
+        assert report.wrong_tier == 10
+        assert report.divergence_ratio == pytest.approx(1.0)
+
+    def test_parent_chains_resolved_through_engine_hashes(self):
+        """Inventory blocks chained off parents (as engines publish
+        them) must resolve to the same request keys the event path
+        computed — no false divergence from the split."""
+        index = self._index()
+        proc = processor()
+        rng = random.Random(7)
+        tokens = [rng.randrange(1, 1000) for _ in range(BLOCK_SIZE * 12)]
+        request_keys = proc.tokens_to_kv_block_keys(0, tokens, MODEL)
+        engine_hashes = [k ^ 0xBEEF for k in request_keys]
+        index.add(engine_hashes, request_keys, [PodEntry("p0", "hbm")])
+        split = 5
+        blocks = [
+            InventoryBlock(
+                block_hashes=engine_hashes[:split],
+                token_ids=tokens[: split * BLOCK_SIZE],
+                block_size=BLOCK_SIZE,
+                medium="hbm",
+            ),
+            InventoryBlock(
+                block_hashes=engine_hashes[split:],
+                token_ids=tokens[split * BLOCK_SIZE:],
+                block_size=BLOCK_SIZE,
+                parent_block_hash=engine_hashes[split - 1],
+                medium="hbm",
+            ),
+        ]
+        auditor = self._auditor(
+            index,
+            lambda p: PodInventory(
+                pod_identifier=p, model_name=MODEL, blocks=blocks
+            ),
+        )
+        report = auditor.audit_pod("p0")
+        assert report.outcome == "clean", report.to_dict()
+        assert report.unresolvable == 0
+
+    def test_failed_fetch_keeps_pod_unscored(self):
+        index = self._index()
+        SyntheticPod(index, "p0", 5)
+        auditor = self._auditor(index, lambda p: None)
+        report = auditor.audit_pod("p0")
+        assert report.outcome == "failed"
+        assert "p0" not in auditor.status()["divergent_pods"]
+
+    def test_run_cycle_audits_all_pods_and_logs(self):
+        index = self._index()
+        p0 = SyntheticPod(index, "p0", 10, seed=1)
+        p1 = SyntheticPod(index, "p1", 10, seed=2)
+        inventories = {
+            "p0": lambda: p0.inventory(),
+            "p1": lambda: p1.inventory(drop_last=2),
+        }
+        auditor = self._auditor(
+            index, lambda p: inventories[p]()
+        )
+        reports = {r.pod: r for r in auditor.run_cycle()}
+        assert reports["p0"].outcome == "clean"
+        assert reports["p1"].outcome == "divergent"
+        status = auditor.status()
+        assert status["cycles"] == 1 and status["audits"] == 2
+        assert status["divergent_pods"] == {"p1": 0.2}
+        assert [r["pod"] for r in auditor.divergent()] == ["p1"]
+        assert len(auditor.recent()) == 2
+
+    def test_pods_per_cycle_round_robins(self):
+        index = self._index()
+        for i in range(4):
+            SyntheticPod(index, f"p{i}", 4, seed=i)
+        seen = []
+        auditor = self._auditor(
+            index,
+            lambda p: None,  # outcome failed; selection is the point
+            pods_per_cycle=2,
+        )
+        for _ in range(2):
+            seen.extend(r.pod for r in auditor.run_cycle())
+        assert sorted(seen) == ["p0", "p1", "p2", "p3"]
+
+    def test_audit_log_bounded(self):
+        index = self._index()
+        pod = SyntheticPod(index, "p0", 4)
+        auditor = IndexAuditor(
+            index,
+            processor(),
+            CallableInventorySource(lambda p: pod.inventory()),
+            AuditorConfig(interval_s=0.0, log_keep=5),
+        )
+        for _ in range(20):
+            auditor.audit_pod("p0")
+        assert len(auditor.recent(100)) == 5
+
+    def test_background_worker_runs_cycles(self):
+        import time as _time
+
+        index = self._index()
+        pod = SyntheticPod(index, "p0", 4)
+        auditor = IndexAuditor(
+            index,
+            processor(),
+            CallableInventorySource(lambda p: pod.inventory()),
+            AuditorConfig(interval_s=0.05),
+        )
+        auditor.start()
+        try:
+            deadline = _time.time() + 10
+            while (
+                auditor.status()["cycles"] < 2
+                and _time.time() < deadline
+            ):
+                _time.sleep(0.02)
+            assert auditor.status()["cycles"] >= 2
+        finally:
+            auditor.close()
+        assert not auditor.status()["running"]
+
+
+# ------------------------- debug surface e2e ---------------------------
+
+
+@pytest.fixture()
+def analytics_service():
+    from tests.helpers.tiny_tokenizer import save_tokenizer_json
+    from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+        LocalFastTokenizer,
+    )
+
+    ledger = CacheStatsLedger(
+        LedgerConfig(sample_rate=1.0, tier_sample=1)
+    )
+    tokenizer_dir = save_tokenizer_json(tempfile.mkdtemp(), MODEL)
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE
+            ),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                workers=2, model_name=MODEL
+            ),
+        ),
+        tokenizer=LocalFastTokenizer(tokenizer_dir),
+        cache_stats_ledger=ledger,
+    )
+    indexer.run()
+    pod = None
+    source_state = {}
+
+    def fetch(pod_id):
+        fn = source_state.get(pod_id)
+        return fn() if fn else None
+
+    auditor = IndexAuditor(
+        indexer.kv_block_index,
+        indexer.token_processor,
+        CallableInventorySource(fetch),
+        AuditorConfig(interval_s=0.0),
+    )
+    server = serve(indexer, host="127.0.0.1", port=0, auditor=auditor)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield base, indexer, auditor, source_state
+    del pod
+    server.shutdown()
+    indexer.shutdown()
+
+
+def http_json(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as response:
+        return response.status, json.load(response)
+
+
+def http_score(base, prompt):
+    request = urllib.request.Request(
+        base + "/score_completions",
+        data=json.dumps({"prompt": prompt, "model": MODEL}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(request, timeout=10) as response:
+        return json.load(response)
+
+
+class TestDebugCachestatsEndpoint:
+    def test_endpoint_and_healthz(self, analytics_service):
+        base, indexer, auditor, _ = analytics_service
+        prompt = "the quick brown fox jumps over the lazy dog . " * 4
+        tokens = indexer.tokenization_pool.tokenize(prompt, MODEL, None)
+        keys = indexer.token_processor.tokens_to_kv_block_keys(
+            0, tokens, MODEL
+        )
+        indexer.kv_block_index.add(
+            keys, keys, [PodEntry("pod-1", "hbm")]
+        )
+        for _ in range(2):
+            http_score(base, prompt)
+        status, stats = http_json(base, "/debug/cachestats")
+        assert status == 200
+        assert stats["totals"]["recorded"] == 2
+        assert stats["totals"]["hits"] >= 1
+        assert stats["windows"]["1m"]["requests"] == 2
+        family_id = stats["top_families"][0]["family"]
+        status, detail = http_json(
+            base, f"/debug/cachestats?family={family_id}"
+        )
+        assert status == 200 and detail["family"] == family_id
+        # Unknown family -> 404; bad hex -> 400.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            http_json(base, "/debug/cachestats?family=00000000000000ff")
+        assert err.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            http_json(base, "/debug/cachestats?family=zzz")
+        assert err.value.code == 400
+        status, health = http_json(base, "/healthz")
+        assert health["analytics"]["cachestats"]["recorded"] == 2
+        assert "audit" in health["analytics"]
+
+    def test_disabled_ledger_404s(self):
+        indexer = make_indexer(cache_stats=False)
+        server = serve(indexer, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                http_json(base, "/debug/cachestats")
+            assert err.value.code == 404
+            # healthz answers without an analytics block.
+            _, health = http_json(base, "/healthz")
+            assert "analytics" not in health
+        finally:
+            server.shutdown()
+            indexer.shutdown()

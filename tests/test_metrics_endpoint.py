@@ -16,6 +16,7 @@ import json
 import logging
 import re
 import tempfile
+import threading
 import time
 import urllib.request
 
@@ -29,9 +30,11 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
     TokenProcessorConfig,
 )
 from llm_d_kv_cache_manager_tpu.metrics.collector import (
+    MAX_LABEL_LEN,
     METRICS,
     counter_total,
     gauge_value,
+    safe_label,
     start_metrics_logging,
 )
 from llm_d_kv_cache_manager_tpu.tokenization.pool import (
@@ -142,6 +145,104 @@ class TestMetricsEndpoint:
         }
         # Sub-millisecond resolution (Prometheus defaults start at 5ms).
         assert {"5e-05", "0.0001", "0.00025", "0.0005", "0.001"} <= les
+
+
+class TestExpositionHardening:
+    """Label values are wire input on the pod-labeled families: the
+    text format's escaping (backslash, double-quote, newline) must
+    round-trip through the real exposition path, and scrapes must be
+    consistent under concurrent writes."""
+
+    def test_label_values_escaped_per_text_format(self, service):
+        # Through the process-global registry the service actually
+        # exposes: a hostile pod name exercising all three escaped
+        # characters.  safe_label (the wire-ingestion guard) passes
+        # printable backslash/quote through untouched, so the
+        # exposition layer is what must escape them.
+        hostile = 'pod"quote\\back'
+        assert safe_label(hostile) == hostile
+        METRICS.kvevents_pod_shed.labels(pod=safe_label(hostile)).inc()
+        _, text = fetch_metrics(service)
+        # Prometheus text format: \ -> \\ then " -> \" inside quotes.
+        assert 'pod="pod\\"quote\\\\back"' in text
+
+    def test_newline_label_escaped_at_exposition(self):
+        # Escaping contract pinned at the library boundary: a raw
+        # newline in a label value (safe_label strips these from wire
+        # input, but embedders can label with anything) must come out
+        # as the two-character escape, never a literal line break that
+        # corrupts the exposition.
+        from prometheus_client import generate_latest
+
+        registry = CollectorRegistry()
+        counter = Counter("t_esc", "d.", ("who",), registry=registry)
+        counter.labels(who="a\nb").inc()
+        text = generate_latest(registry).decode()
+        assert 'who="a\\nb"' in text
+        sample_lines = [
+            line for line in text.splitlines() if line.startswith("t_esc")
+        ]
+        assert all("a\\nb" in line for line in sample_lines if "who" in line)
+
+    def test_safe_label_bounds_and_sanitizes(self):
+        assert safe_label("pod-7") == "pod-7"
+        cleaned = safe_label("a\x00b\x1fc\x7fd")
+        assert "\x00" not in cleaned and "\x7f" not in cleaned
+        assert cleaned == "a�b�c�d"
+        long = safe_label("x" * 1000)
+        assert len(long) == MAX_LABEL_LEN
+        assert long.endswith("…")
+
+    def test_concurrent_scrape_vs_write_contract(self, service):
+        """Scrapes while labeled families churn must always parse: every
+        sample line is name{labels} value, no torn lines, no duplicate
+        HELP/TYPE per family — the contract a Prometheus server relies
+        on."""
+        stop = threading.Event()
+        errors = []
+
+        def writer(i):
+            n = 0
+            while not stop.is_set():
+                METRICS.kvevents_pod_shed.labels(pod=f"w{i}-pod{n % 7}").inc()
+                METRICS.kvevents_pod_backlog.labels(
+                    pod=f"w{i}-pod{n % 7}"
+                ).set(n)
+                METRICS.kvevents_dropped.labels(reason="queue_full").inc()
+                n += 1
+
+        threads = [
+            threading.Thread(target=writer, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            line_re = re.compile(
+                r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
+                r"[-+0-9.eEinfNa]+$"
+            )
+            for _ in range(10):
+                _, text = fetch_metrics(service)
+                seen_help = set()
+                for line in text.splitlines():
+                    if not line:
+                        continue
+                    if line.startswith("# HELP "):
+                        name = line.split(" ", 3)[2]
+                        if name in seen_help:
+                            errors.append(f"duplicate HELP for {name}")
+                        seen_help.add(name)
+                        continue
+                    if line.startswith("#"):
+                        continue
+                    if not line_re.match(line):
+                        errors.append(f"unparseable sample line: {line!r}")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+        assert not errors, errors[:5]
 
 
 class TestCollectorHelpers:
