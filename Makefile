@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke cachestats-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke cachestats-smoke tiering-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -77,6 +77,14 @@ perf-smoke:
 # (docs/observability.md).
 cachestats-smoke:
 	$(CPU_ENV) $(PYTHON) hack/cachestats_smoke.py
+
+# Tiering smoke (same invocation as CI's "Tiering smoke" step):
+# booted service with the policy engine — traffic teaches the
+# PolicyFeed, a forced demotion lands in /debug/tiering, /metrics AND
+# the live score (1.0 -> 0.8/block), and the compute-or-load advice
+# flips when the RTT estimator is inflated (docs/tiering.md).
+tiering-smoke:
+	$(CPU_ENV) $(PYTHON) hack/tiering_smoke.py
 
 # Event-plane smoke (same invocation as CI's "Event-plane smoke"
 # step): consolidated poller over ~64 inproc publishers — throughput

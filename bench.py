@@ -240,6 +240,22 @@ def emit_result(full: dict, probe: dict) -> None:
             "within_3pct": overhead.get("within_3pct"),
             "parity": overhead.get("parity"),
         }
+    tiered_churn = detail.get("tiered_churn") or {}
+    tiered_churn_compact = None
+    if tiered_churn and "eviction_ab" in tiered_churn:
+        ab = tiered_churn.get("eviction_ab") or {}
+        col = tiered_churn.get("compute_or_load") or {}
+        tiered_churn_compact = {
+            "hit_lru": ab.get("hit_rate_lru"),
+            "hit_pred": ab.get("hit_rate_predictive"),
+            "beats_lru": ab.get("beats_lru"),
+            "parity": ab.get("policy_off_parity"),
+            "ttft_load_s": col.get("ttft_load_s"),
+            "ttft_recompute_s": col.get("ttft_recompute_s"),
+            "ttft_hybrid_s": col.get("ttft_hybrid_s"),
+            "hybrid_ok": col.get("hybrid_le_min_pure"),
+            "advice": (col.get("advice") or {}).get("action"),
+        }
     event_storm = detail.get("event_storm") or {}
     event_storm_compact = None
     if event_storm and "n_pods" in event_storm:
@@ -267,6 +283,7 @@ def emit_result(full: dict, probe: dict) -> None:
         "routing_precise_us": detail.get("routing_precise_us"),
         "read_path": read_path_compact,
         "cache_analytics": cache_analytics_compact,
+        "tiered_churn": tiered_churn_compact,
         "event_storm": event_storm_compact,
         "indexer_restart": detail.get("indexer_restart"),
         "elapsed_s": detail.get("elapsed_s"),
@@ -281,6 +298,7 @@ def emit_result(full: dict, probe: dict) -> None:
     for key in (
         "indexer_restart",
         "event_storm",
+        "tiered_churn",
         "cache_analytics",
         "read_path",
         "routing_precise_us",
@@ -449,6 +467,9 @@ class SimPod:
         # plus the reverse map so reuse evicts the old resident.
         self.cached: Dict[int, int] = {}
         self._block_owner: Dict[int, int] = {}
+        # Optional eviction journal (tiered_churn parity cell): when a
+        # list is attached, alloc() appends every evicted hash in order.
+        self.evict_log: Optional[List[int]] = None
 
     def alloc(self, n: int) -> Tuple[List[int], List[int]]:
         """Bump-allocate n blocks; returns (ids, evicted block hashes).
@@ -464,6 +485,8 @@ class SimPod:
             if old is not None and self.cached.get(old) == bid:
                 del self.cached[old]
                 evicted.append(old)
+        if self.evict_log is not None:
+            self.evict_log.extend(evicted)
         return ids, evicted
 
     def cached_prefix_blocks(self, block_hashes: Sequence[int]) -> List[int]:
@@ -474,6 +497,147 @@ class SimPod:
                 break
             ids.append(self.cached[h])
         return ids
+
+
+class TieredFleetPolicy:
+    """Shared policy state for a tiered_churn predictive run: ONE
+    ledger + PolicyFeed across the fleet (the engine-chain analogue of
+    the indexer-side wiring — the PolicyFeed contract is key-space
+    agnostic, and here the pods' own block-hash chains feed it)."""
+
+    def __init__(self) -> None:
+        from llm_d_kv_cache_manager_tpu.analytics.ledger import (
+            CacheStatsLedger,
+            LedgerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.tiering import PolicyFeed
+
+        self.ledger = CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+        self.feed = PolicyFeed(ledger=self.ledger)
+
+    def close(self) -> None:
+        self.ledger.close()
+
+
+class TieredSimPod(SimPod):
+    """SimPod + the predictive tiering policy at the engine edge.
+
+    Reuse-aware **admission + protection** (the TinyLFU-flavored rule
+    from docs/tiering.md): the pod protects one incumbent prefix
+    family's blocks from eviction; a challenger family is admitted
+    into the cache (registered + advertised) only when the PolicyFeed
+    predicts its reuse strictly better (2x shorter expected next use)
+    than the incumbent's — otherwise it is served **transiently**:
+    blocks are allocated from the unprotected region and never
+    registered, so the incumbent's working set survives churn and the
+    index is never told about blocks the pod won't keep.
+
+    ``tiering=None`` is the parity oracle: every code path delegates
+    to the pristine SimPod behavior, bit-identically (asserted by the
+    bench's tiered_churn parity cell).
+    """
+
+    # Fraction of the pool the incumbent may pin; the rest stays a
+    # churn region so transient requests always progress.
+    PROTECT_FRACTION = 0.85
+
+    def __init__(self, *args, tiering: Optional[TieredFleetPolicy] = None,
+                 **kw) -> None:
+        super().__init__(*args, **kw)
+        self.tiering = tiering
+        self.protected_ids: set = set()
+        self.protected_family: Optional[int] = None
+        # Decisions for the in-flight request (prepare_request ->
+        # alloc -> commit ride the same virtual-clock step).
+        self.register_current = True
+        self._pending_protect: Optional[int] = None
+        self._protect_cap = int(self.pool_blocks * self.PROTECT_FRACTION)
+
+    # -- per-request policy hooks (called by _fleet_step/commit) --------
+
+    def prepare_request(self, hashes: Sequence[int]) -> None:
+        """Record the arrival, then decide admission/protection for
+        this request BEFORE account() allocates."""
+        if self.tiering is None:
+            return
+        ledger, feed = self.tiering.ledger, self.tiering.feed
+        family = ledger.family_key(hashes, len(hashes))
+        matched = len(self.cached_prefix_blocks(hashes))
+        ledger.record(family, MODEL_NAME, len(hashes), matched)
+        feed.observe_chain(hashes, family)
+        self.register_current = True
+        self._pending_protect = None
+        if self.protected_family is None:
+            # No incumbent: this family takes the seat (protection
+            # lands on its block ids at commit).
+            self._pending_protect = family
+        elif family == self.protected_family:
+            if matched == 0:
+                # Defensive (protected blocks cannot normally be
+                # evicted): rebuild protection from this request.
+                self.protected_ids.clear()
+                self._pending_protect = family
+        else:
+            challenger = feed.prediction(family)
+            incumbent = feed.prediction(self.protected_family)
+            now = time.monotonic()
+            swap = (
+                challenger is not None
+                and (
+                    incumbent is None
+                    or challenger.expected_next_use_s(now) * 2.0
+                    < incumbent.expected_next_use_s(now)
+                )
+            )
+            if swap:
+                self.protected_ids.clear()
+                self._pending_protect = family
+            else:
+                # Transient service: the incumbent's working set is
+                # worth more than caching this request.
+                self.register_current = False
+
+    def commit_blocks(self, hashes: Sequence[int],
+                      block_ids: Sequence[int]) -> None:
+        """Post-registration hook: pin the just-admitted family's
+        blocks (up to the protect cap)."""
+        if self.tiering is None or self._pending_protect is None:
+            return
+        self.protected_family = self._pending_protect
+        self._pending_protect = None
+        room = self._protect_cap - len(self.protected_ids)
+        if room > 0:
+            self.protected_ids.update(block_ids[:room])
+
+    def alloc(self, n: int) -> Tuple[List[int], List[int]]:
+        if self.tiering is None or not self.protected_ids:
+            return super().alloc(n)
+        # Ring allocation skipping protected ids.  A transient request
+        # larger than the unprotected region reuses ids WITHIN itself
+        # (real engines serve an over-sized transient request by
+        # recycling its own scratch blocks); such requests are never
+        # registered, so no stale cache mappings can form.
+        ids: List[int] = []
+        evicted: List[int] = []
+        cursor = self._next_block
+        scanned = 0
+        while len(ids) < n:
+            bid = cursor % self.pool_blocks
+            cursor += 1
+            scanned += 1
+            if bid in self.protected_ids:
+                continue
+            ids.append(bid)
+            old = self._block_owner.pop(bid, None)
+            if old is not None and self.cached.get(old) == bid:
+                del self.cached[old]
+                evicted.append(old)
+            if scanned >= self.pool_blocks:
+                scanned = 0  # wrapped: continue into duplicates
+        self._next_block = cursor % self.pool_blocks
+        if self.evict_log is not None:
+            self.evict_log.extend(evicted)
+        return ids, evicted
 
 
 def block_hash_chain(tokens: Sequence[int]) -> List[int]:
@@ -590,17 +754,17 @@ class FleetRouter:
         journal=None,
         cache_stats_ledger=None,
         exact_tokenize: bool = False,
+        pod_factory=None,
     ) -> None:
         self.strategy = strategy
-        self.pods = [
-            SimPod(
-                f"pod-{i}",
-                params,
-                with_kv=with_kv,
-                pool_blocks=pool_blocks,
-            )
-            for i in range(NUM_PODS)
-        ]
+        # pod_factory(name) lets a regime substitute policy-aware pods
+        # (tiered_churn); None keeps the plain SimPod fleet.
+        if pod_factory is None:
+            def pod_factory(name):
+                return SimPod(
+                    name, params, with_kv=with_kv, pool_blocks=pool_blocks
+                )
+        self.pods = [pod_factory(f"pod-{i}") for i in range(NUM_PODS)]
         self.pod_by_name = {p.name: p for p in self.pods}
         self.pod_free_at: Dict[str, float] = {
             p.name: 0.0 for p in self.pods
@@ -740,9 +904,19 @@ class FleetRouter:
         the allocator wrapped into the cached prefix region, mapping
         them to blocks that now hold suffix KV.  Then feed whichever
         learning mechanism the strategy uses."""
-        for h, bid in zip(hashes[first_new:], block_ids[first_new:]):
-            pod.cached[h] = bid
-            pod._block_owner[bid] = h
+        if not getattr(pod, "register_current", True):
+            # Tiering admission control declined this request: the
+            # blocks were transient scratch — no cache registration and
+            # no BlockStored advertisement (the index must never claim
+            # blocks the pod won't keep); evictions still publish.
+            first_new = len(hashes)
+        else:
+            for h, bid in zip(hashes[first_new:], block_ids[first_new:]):
+                pod.cached[h] = bid
+                pod._block_owner[bid] = h
+            protect = getattr(pod, "commit_blocks", None)
+            if protect is not None:
+                protect(hashes, block_ids)
         if self.event_pool is not None:
             publish_events(
                 self.event_pool, pod, tokens, hashes, first_new, evicted
@@ -766,6 +940,7 @@ def run_fleet_virtual(
     reset_history_at: Optional[int] = None,
     cache_stats_ledger=None,
     exact_tokenize: bool = False,
+    pod_factory=None,
 ) -> Tuple[List[float], float, float, List[float]]:
     """One matrix cell: the request stream under ``strategy`` on the
     virtual clock, service times taken from the measured on-device
@@ -785,6 +960,7 @@ def run_fleet_virtual(
         pool_blocks=pool_blocks,
         cache_stats_ledger=cache_stats_ledger,
         exact_tokenize=exact_tokenize,
+        pod_factory=pod_factory,
     )
     ttfts: List[float] = []
     depths: List[int] = []
@@ -823,6 +999,11 @@ def _fleet_step(
     contract."""
     group, text, tokens = request
     pod, routing_seconds = fleet.route(text, hashes)
+    prepare = getattr(pod, "prepare_request", None)
+    if prepare is not None:
+        # Tiering policy hook (TieredSimPod): record the arrival and
+        # decide admission/protection before account() allocates.
+        prepare(hashes)
     hit, first_new, block_ids, evicted = fleet.account(pod, hashes)
     service_seconds = t_hit if hit else t_miss
     depth = sum(1 for c in fleet.completions[pod.name] if c > arrival)
@@ -2286,6 +2467,182 @@ def maybe_bench_cache_analytics(context: str) -> dict:
         return {"error": detail[:300]}
 
 
+# ---------------- tiered_churn: predictive tiering regime --------------
+
+# Calibrated offload-path constants for the compute-or-load cell when
+# no device RTT was measured this run: r05's measured readback floor,
+# and a host<->storage streaming bandwidth for the synthetic load
+# observations fed to the advisor's estimator (labeled calibrated,
+# never measured).
+CAL_READBACK_S = _env_float("KVTPU_BENCH_CAL_READBACK_S", 0.065)
+CAL_HOST_BW_BYTES_S = _env_float("KVTPU_BENCH_HOST_BW_GBPS", 5.0) * 1e9
+
+
+def _tiered_churn_run(pod_factory, seed: int):
+    """One churn-workload run (the r05 regime's exact geometry: same
+    prompts, same pool, same QPS) under the given pod factory; returns
+    (hit_rate, per-pod eviction logs)."""
+    rng = random.Random(9090)
+    requests = make_prompts(rng)
+    hashes_list = [block_hash_chain(tokens) for _, _, tokens in requests]
+    t_miss, t_hit = CAL_MISS_S, CAL_HIT_S
+    ideal = ideal_service_time(t_miss, t_hit, len(requests))
+    qps = 0.7 * NUM_PODS / ideal
+    arrivals = poisson_arrivals(qps, len(requests), seed)
+    logs: Dict[str, List[int]] = {}
+
+    def factory(name):
+        pod = pod_factory(name)
+        pod.evict_log = logs.setdefault(name, [])
+        return pod
+
+    _, hit_rate, _, _ = run_fleet_virtual(
+        "precise",
+        requests,
+        hashes_list,
+        arrivals,
+        t_miss,
+        t_hit,
+        seed,
+        pool_blocks=CHURN_POOL_BLOCKS,
+        pod_factory=factory,
+    )
+    return hit_rate, logs
+
+
+def bench_tiered_churn(readback_rtt: Optional[float] = None) -> dict:
+    """detail.tiered_churn regime (docs/tiering.md), device-free:
+
+    1. **eviction-policy A/B** — the r05 churn workload through the
+       real precise read+write path twice in one run: the LRU/ring
+       baseline (today's eviction order) vs TieredSimPod driving the
+       real PolicyFeed + ledger (reuse-aware protection/admission).
+       The predictive arm must beat the baseline hit rate (r05
+       stalled at 0.375 — the headroom ROADMAP item 4 names).
+    2. **policy-off parity** — TieredSimPod with tiering=None must
+       reproduce the baseline's hit rate AND per-pod eviction order
+       bit-identically (the escape hatch is the oracle).
+    3. **compute-or-load** — TTFT for a fully-offloaded shared prefix
+       under pure-load vs pure-recompute vs hybrid overlap, priced by
+       the real ComputeOrLoadAdvisor fed with the measured (or
+       calibrated r05) readback floor; hybrid must be <= the best
+       pure arm within noise.
+    """
+    from llm_d_kv_cache_manager_tpu.tiering import (
+        AdvisorConfig,
+        ComputeOrLoadAdvisor,
+    )
+
+    result: dict = {}
+    seed = ARRIVAL_SEEDS[0]
+
+    # -- cells 1+2: eviction-policy A/B + parity, one run each arm --
+    baseline_hit, baseline_logs = _tiered_churn_run(
+        lambda name: SimPod(name, with_kv=False,
+                            pool_blocks=CHURN_POOL_BLOCKS),
+        seed,
+    )
+    parity_hit, parity_logs = _tiered_churn_run(
+        lambda name: TieredSimPod(name, with_kv=False,
+                                  pool_blocks=CHURN_POOL_BLOCKS,
+                                  tiering=None),
+        seed,
+    )
+    policy = TieredFleetPolicy()
+    try:
+        predictive_hit, _ = _tiered_churn_run(
+            lambda name: TieredSimPod(name, with_kv=False,
+                                      pool_blocks=CHURN_POOL_BLOCKS,
+                                      tiering=policy),
+            seed,
+        )
+    finally:
+        policy.close()
+    parity_ok = (
+        parity_hit == baseline_hit and parity_logs == baseline_logs
+    )
+    result["eviction_ab"] = {
+        "workload": "churn",
+        "pool_blocks": CHURN_POOL_BLOCKS,
+        "hit_rate_lru": round(baseline_hit, 4),
+        "hit_rate_predictive": round(predictive_hit, 4),
+        "beats_lru": predictive_hit > baseline_hit,
+        "policy_off_parity": parity_ok,
+        "evictions_lru": sum(len(v) for v in baseline_logs.values()),
+    }
+
+    # -- cell 3: compute-or-load TTFT (single offloaded-prefix point) --
+    n_prefix_blocks = PREFIX_TOKENS // BLOCK_SIZE
+    # Per-block KV bytes of the bench model (bf16 = 2 bytes).
+    bytes_per_block = (
+        2 * CFG.n_layers * CFG.block_size * CFG.n_kv_heads
+        * CFG.head_dim * 2
+    )
+    prefill_rate = TOTAL_TOKENS / CAL_MISS_S
+    rtt_floor = (
+        readback_rtt
+        if readback_rtt and readback_rtt > 0
+        else CAL_READBACK_S
+    )
+    advisor = ComputeOrLoadAdvisor(
+        AdvisorConfig(
+            bytes_per_block=bytes_per_block,
+            block_tokens=BLOCK_SIZE,
+            prefill_tokens_per_s=prefill_rate,
+            rtt_floor_s=rtt_floor,
+        )
+    )
+    # Synthetic load observations at the calibrated bandwidth — the
+    # shape the offload worker's rtt_observer would feed live.
+    for nbytes in (1 << 20, 8 << 20, 64 << 20):
+        advisor.observe_load(
+            nbytes, rtt_floor + nbytes / CAL_HOST_BW_BYTES_S
+        )
+    advice = advisor.advise(n_prefix_blocks)
+    suffix_s = SUFFIX_TOKENS / prefill_rate
+    ttft_load = advice.load_s + suffix_s
+    ttft_recompute = (PREFIX_TOKENS + SUFFIX_TOKENS) / prefill_rate
+    hybrid_core = (
+        advice.hybrid_s
+        if advice.hybrid_s is not None
+        else min(advice.load_s, advice.recompute_s)
+    )
+    ttft_hybrid = hybrid_core + suffix_s
+    best_pure = min(ttft_load, ttft_recompute)
+    result["compute_or_load"] = {
+        "prefix_blocks": n_prefix_blocks,
+        "prefix_bytes": n_prefix_blocks * bytes_per_block,
+        "rtt_floor_s": round(rtt_floor, 4),
+        "rtt_source": (
+            "measured" if readback_rtt and readback_rtt > 0
+            else "calibrated"
+        ),
+        "host_bw_bytes_s": CAL_HOST_BW_BYTES_S,
+        "prefill_tokens_per_s": round(prefill_rate, 1),
+        "ttft_load_s": round(ttft_load, 4),
+        "ttft_recompute_s": round(ttft_recompute, 4),
+        "ttft_hybrid_s": round(ttft_hybrid, 4),
+        "hybrid_le_min_pure": ttft_hybrid <= best_pure * 1.001 + 1e-9,
+        "advice": advice.to_dict(),
+    }
+    return result
+
+
+def maybe_bench_tiered_churn(
+    context: str, readback_rtt: Optional[float] = None
+) -> dict:
+    """bench_tiered_churn under the degrade contract."""
+    if _over_budget(reserve_s=60.0):
+        return {"truncated": True}
+    _progress(f"{context}: tiered_churn regime (eviction A/B)")
+    try:
+        return bench_tiered_churn(readback_rtt)
+    except Exception as exc:  # noqa: BLE001 — optional layer
+        detail = f"{type(exc).__name__}: {exc}"
+        _progress(f"tiered_churn failed: {detail}")
+        return {"error": detail[:300]}
+
+
 # ---------------- event_storm: fleet-scale event-plane regime ----------
 
 _STORM_TINY = bool(os.environ.get("KVTPU_BENCH_TINY"))
@@ -3037,6 +3394,7 @@ def emit_cpu_fallback(device_error: str, probe: dict) -> None:
     micro = maybe_bench_micro("fallback")
     read_path = maybe_bench_read_path("fallback")
     cache_analytics = maybe_bench_cache_analytics("fallback")
+    tiered_churn = maybe_bench_tiered_churn("fallback")
     event_storm = maybe_bench_event_storm("fallback")
     indexer_restart = maybe_bench_indexer_restart(
         requests, hashes_list, t_miss, t_hit, ideal_service
@@ -3064,6 +3422,7 @@ def emit_cpu_fallback(device_error: str, probe: dict) -> None:
                 "micro": micro,
                 "read_path": read_path,
                 "cache_analytics": cache_analytics,
+                "tiered_churn": tiered_churn,
                 "event_storm": event_storm,
                 "indexer_restart": indexer_restart,
                 "requests": len(requests),
@@ -3264,6 +3623,13 @@ def main() -> None:
     # overhead A/B — device-free.
     cache_analytics = maybe_bench_cache_analytics("detail.cache_analytics")
 
+    # detail.tiered_churn: predictive-eviction A/B on the churn
+    # workload + compute-or-load TTFT (docs/tiering.md), device-free
+    # except for the measured readback floor.
+    tiered_churn = maybe_bench_tiered_churn(
+        "detail.tiered_churn", readback_rtt
+    )
+
     # detail.event_storm: fleet-scale event-plane regime (consolidated
     # poller vs thread-per-pod, per-pod fairness, gap->resync),
     # device-free.
@@ -3315,6 +3681,7 @@ def main() -> None:
                 "micro": micro,
                 "read_path": read_path,
                 "cache_analytics": cache_analytics,
+                "tiered_churn": tiered_churn,
                 "event_storm": event_storm,
                 "indexer_restart": indexer_restart,
                 "service_times": "measured",

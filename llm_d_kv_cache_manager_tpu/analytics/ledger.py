@@ -599,6 +599,24 @@ class CacheStatsLedger:
             stats = self._stripes[stripe_index].get(family)
             return stats.ewma_interarrival_s if stats is not None else None
 
+    def reuse_predictions(self):
+        """Bulk export of the reuse signal: ``(family,
+        ewma_interarrival_s, last_seen, requests)`` for every tracked
+        family seen at least twice — the PolicyFeed's snapshot input
+        (tiering/policy_feed.py).  One stripe lock at a time, never
+        nested; O(families tracked), for periodic refreshes rather
+        than per-request calls."""
+        out = []
+        for stripe_index, stripe in enumerate(self._stripes):
+            with self._stripe_locks[stripe_index]:
+                for family, stats in stripe.items():
+                    ewma = stats.ewma_interarrival_s
+                    if ewma is not None:
+                        out.append(
+                            (family, ewma, stats.last_seen, stats.requests)
+                        )
+        return out
+
     def tier_detail_due(self) -> bool:
         """Cheap modulo gate for per-tier attribution (every Nth
         sampled request pays the per-block tier walk; see

@@ -52,6 +52,7 @@ def _make_handler(
     recovery_report=None,
     event_plane_status=None,
     auditor=None,
+    tiering=None,
 ):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -224,6 +225,21 @@ def _make_handler(
                     analytics = {"error": "unavailable"}
                 if analytics:
                     health["analytics"] = analytics
+                if tiering is not None:
+                    # Compact: full engine status lives at
+                    # /debug/tiering; health carries the liveness bits.
+                    try:
+                        status = tiering.status()
+                        health["tiering"] = {
+                            "feed": status["feed"]["snapshot"],
+                            "advice_counts": status["advisor"][
+                                "advice_counts"
+                            ],
+                            "demotion_workers": len(status["demotion"]),
+                        }
+                    except Exception:  # noqa: BLE001 — health must answer
+                        logger.exception("tiering status failed")
+                        health["tiering"] = {"error": "unavailable"}
                 self._reply_json(200, health)
             elif path == "/debug/traces":
                 self._debug_traces(query)
@@ -231,8 +247,26 @@ def _make_handler(
                 self._debug_trace_by_id(path[len("/debug/traces/"):])
             elif path == "/debug/cachestats":
                 self._debug_cachestats(query)
+            elif path == "/debug/tiering":
+                self._debug_tiering()
             else:
                 self._error(404, "not found")
+
+        def _debug_tiering(self):
+            """Read-only tiering policy plane: feed/snapshot stats,
+            compute-or-load advisor state, predictive-eviction
+            counters, demotion worker status + recent transitions
+            (docs/tiering.md)."""
+            if tiering is None:
+                self._error(404, "tiering disabled (set TIERING=1)")
+                return
+            try:
+                payload = tiering.status()
+            except Exception as exc:  # noqa: BLE001 — debug must answer
+                logger.exception("tiering status failed")
+                self._error(500, f"error: {exc}")
+                return
+            self._reply_json(200, payload)
 
         def _debug_cachestats(self, query):
             """Read-only cache-efficiency analytics: ledger totals,
@@ -532,6 +566,7 @@ def serve(
     recovery_report=None,
     event_plane_status=None,
     auditor=None,
+    tiering=None,
 ) -> http.server.ThreadingHTTPServer:
     """Start the HTTP service on a background thread; returns the server
     (call ``.shutdown()`` to stop).  ``admin_token`` (env:
@@ -544,7 +579,8 @@ def serve(
     hit-attribution ledger (``indexer.cache_stats``) backs
     ``GET /debug/cachestats`` and the ``/healthz`` analytics block;
     ``auditor`` (an ``analytics.IndexAuditor``) adds the index-truth
-    audit plane to both."""
+    audit plane to both; ``tiering`` (a ``tiering.PolicyEngine``)
+    backs ``GET /debug/tiering`` and the ``/healthz`` tiering block."""
     server = http.server.ThreadingHTTPServer(
         (host, port),
         _make_handler(
@@ -554,6 +590,7 @@ def serve(
             recovery_report=recovery_report,
             event_plane_status=event_plane_status,
             auditor=auditor,
+            tiering=tiering,
         ),
     )
     thread = threading.Thread(
@@ -629,6 +666,18 @@ def main() -> None:  # pragma: no cover - CLI entry
     )
     indexer = Indexer(config)
     indexer.run()
+
+    # TIERING=1 attaches the predictive-tiering policy engine
+    # (docs/tiering.md): the scoring stream feeds its PolicyFeed,
+    # explain carries compute-or-load advice, and /debug/tiering
+    # exposes the policy plane.  The demotion worker needs a pod-side
+    # target, so the standalone indexer runs without one.
+    policy_engine = None
+    if os.environ.get("TIERING", "").lower() in ("1", "true", "yes"):
+        from llm_d_kv_cache_manager_tpu.tiering import PolicyEngine
+
+        policy_engine = PolicyEngine(ledger=indexer.cache_stats)
+        indexer.set_policy_engine(policy_engine)
 
     # PERSISTENCE_DIR enables warm restarts: recover the index from the
     # last snapshot + journal tail BEFORE the event pool starts, then
@@ -765,6 +814,7 @@ def main() -> None:  # pragma: no cover - CLI entry
         persistence=persistence,
         recovery_report=recovery_report,
         event_plane_status=event_plane_status,
+        tiering=policy_engine,
     )
     try:
         threading.Event().wait()
@@ -789,6 +839,8 @@ def main() -> None:  # pragma: no cover - CLI entry
             except Exception:  # noqa: BLE001 - best-effort on the way out
                 logger.exception("shutdown snapshot failed")
             persistence.close()
+        if policy_engine is not None:
+            policy_engine.close()
         indexer.shutdown()
 
 

@@ -227,6 +227,12 @@ class IndexerConfig:
     # gated by CACHESTATS_SAMPLE_RATE.  None resolves from the
     # CACHESTATS env knob (default on); False disables.
     cache_stats: Optional[bool] = None
+    # Predictive tiering (tiering/engine.py): when a PolicyEngine is
+    # attached (constructor arg or set_policy_engine), sampled scoring
+    # requests feed its PolicyFeed (outside index locks) and the
+    # explain surface carries compute-or-load advice.  Config-only
+    # construction stays None; the engine is wired by the embedding
+    # application (TIERING=1 in the HTTP service).
 
 
 class Indexer:
@@ -239,6 +245,7 @@ class Indexer:
         tokenizer: Optional[Tokenizer] = None,
         chat_processor: Optional[ChatTemplatingProcessor] = None,
         cache_stats_ledger=None,
+        policy_engine=None,
     ) -> None:
         self.config = config or IndexerConfig()
         self.token_processor = token_processor or ChunkedTokenDatabase(
@@ -332,6 +339,13 @@ class Indexer:
                 self.cache_stats = CacheStatsLedger()
                 self._owns_ledger = True
 
+        # Predictive-tiering hook (tiering/engine.py): sampled scoring
+        # requests feed the engine's PolicyFeed, and explain carries
+        # compute-or-load advice.  Attached, never constructed here.
+        self.policy_engine = None
+        if policy_engine is not None:
+            self.set_policy_engine(policy_engine)
+
         if tokenizer is None:
             backends: List[Tokenizer] = []
             if self.config.local_tokenizers_dir:
@@ -364,6 +378,26 @@ class Indexer:
 
     def set_tokenizer(self, tokenizer: Tokenizer, model_name: str) -> None:
         self.tokenization_pool.set_tokenizer(tokenizer, model_name)
+
+    def set_policy_engine(self, policy_engine) -> None:
+        """Attach a tiering PolicyEngine after construction (binds the
+        indexer's ledger to its feed)."""
+        self.policy_engine = policy_engine
+        if policy_engine is None:
+            return
+        if self.cache_stats is not None:
+            policy_engine.bind_ledger(self.cache_stats)
+        else:
+            # Dead configuration (e.g. TIERING=1 with CACHESTATS=0):
+            # every scoring hook gates on the ledger, so the engine
+            # would sit inert — zeros in /debug/tiering, LRU-only
+            # eviction — with nothing explaining why.  Be loud once.
+            logger.warning(
+                "tiering PolicyEngine attached to an indexer without a "
+                "cachestats ledger (CACHESTATS disabled?): the policy "
+                "feed will learn nothing and predictive eviction "
+                "degrades to LRU (docs/tiering.md)"
+            )
 
     def _tokens_and_block_keys(
         self,
@@ -451,14 +485,17 @@ class Indexer:
             if traced:
                 s.set_attr("provenance", _provenance_attr(chain))
         if sampled:
+            family = ledger.family_key(block_keys, len(block_keys))
             _ledger_record(
                 ledger,
-                ledger.family_key(block_keys, len(block_keys)),
+                family,
                 model_name,
                 len(block_keys),
                 chain.matched_blocks,
                 chain.tier_counts,
             )
+            if self.policy_engine is not None:
+                self.policy_engine.observe_scored(block_keys, family)
         logger.debug(
             "scored %d pods over %d block keys", len(scores), len(block_keys)
         )
@@ -538,6 +575,12 @@ class Indexer:
                         hit.matched_blocks,
                         hit.tier_counts,
                     )
+                    if self.policy_engine is not None:
+                        # The elided walk's chain keys are the touched
+                        # resident ones; the rhythm update rides them.
+                        self.policy_engine.observe_scored(
+                            hit.touch_keys, hit.family
+                        )
                 logger.debug(
                     "score-memo hit: %d pods over %d chain keys",
                     len(hit.scores),
@@ -711,6 +754,8 @@ class Indexer:
                 chain.matched_blocks,
                 chain.tier_counts,
             )
+            if self.policy_engine is not None:
+                self.policy_engine.observe_scored(keys_done, family)
 
         tracer = active_trace
         if tracer is not None:
@@ -804,12 +849,39 @@ class Indexer:
             self.scorer.advance(
                 chain, [key_to_pods.get(key, ()) for key in block_keys]
             )
+            family = ledger.family_key(block_keys, len(block_keys))
             _ledger_record(
                 ledger,
-                ledger.family_key(block_keys, len(block_keys)),
+                family,
                 model_name,
                 len(block_keys),
                 chain.matched_blocks,
                 chain.tier_counts,
             )
+            if self.policy_engine is not None:
+                self.policy_engine.observe_scored(block_keys, family)
+        engine = self.policy_engine
+        if engine is not None and per_pod:
+            # Compute-or-load advice for the best pod's resident prefix
+            # (docs/tiering.md): would loading its offloaded KV beat
+            # recomputing it, or should the two overlap?  Advisory —
+            # failures never fail an explain request.
+            try:
+                best_pod, best = max(
+                    per_pod.items(), key=lambda item: item[1]["score"]
+                )
+                tiers = best.get("tiers") or {}
+                tier = (
+                    max(tiers.items(), key=lambda item: item[1])[0]
+                    if tiers
+                    else None
+                )
+                advice = engine.advisor.advise(
+                    best["blocks_matched"], tier=tier
+                )
+                explanation["tiering"] = dict(
+                    advice.to_dict(), pod=best_pod
+                )
+            except Exception:  # noqa: BLE001 — advice is advisory
+                logger.exception("tiering advice failed")
         return scores, explanation

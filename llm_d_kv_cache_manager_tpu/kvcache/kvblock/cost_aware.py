@@ -17,7 +17,10 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
     Index,
     PodEntry,
 )
-from llm_d_kv_cache_manager_tpu.utils import lockorder
+from llm_d_kv_cache_manager_tpu.utils import lockorder, victim
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("kvcache.cost_aware")
 
 # Fixed per-entry overheads (dict slots, key ints, bookkeeping).  These are
 # estimates in the same spirit as the reference's per-entry cost model
@@ -54,11 +57,34 @@ class CostAwareMemoryIndex(Index):
             return self._cost
 
     def _evict_to_budget_locked(self) -> None:
+        policy = self.config.eviction_policy
         while self._cost > self.config.max_cost_bytes and self._data:
-            key, pods = self._data.popitem(last=False)
+            if policy is None:
+                # The parity oracle: pristine pop-LRU-first, exactly
+                # the pre-tiering eviction order (docs/tiering.md).
+                key, pods = self._data.popitem(last=False)
+            else:
+                key = self._select_victim_locked(policy)
+                pods = self._data.pop(key)
             self._cost -= _KEY_OVERHEAD + sum(pods.values())
             for engine_key in self._request_to_engines.pop(key, ()):  # type: ignore[arg-type]
                 self._engine_to_request.pop(engine_key, None)
+
+    def _select_victim_locked(self, policy) -> int:
+        """Predictive victim selection over an LRU-ordered sample.
+
+        The policy ranks ``(key, byte-cost)`` pairs against its own
+        immutable snapshot (no locks taken under ours); the shared
+        guard (utils/victim.py) bounds-checks the answer and falls
+        back to the LRU-first victim on any policy failure."""
+        sample = []
+        limit = victim.sample_limit(policy)
+        for key in self._data:  # insertion order == LRU order
+            pods = self._data[key]
+            sample.append((key, _KEY_OVERHEAD + sum(pods.values())))
+            if len(sample) >= limit:
+                break
+        return sample[victim.guarded_select(policy, sample, logger)][0]
 
     def _admit_locked(
         self, request_key: int, entries: Sequence[PodEntry]

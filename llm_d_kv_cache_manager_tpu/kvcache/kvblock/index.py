@@ -181,6 +181,14 @@ class CostAwareIndexConfig:
     # Approximate memory budget for the index, in bytes (default 2 GiB).
     max_cost_bytes: int = 2 * 1024 * 1024 * 1024
     pod_cache_size: int = 10
+    # Predictive eviction ranking (tiering/eviction.py): an object with
+    # ``select_victim(candidates, now) -> index`` and a ``sample`` size,
+    # called under the index lock with an LRU-ordered (key, byte-cost)
+    # sample — it must take no locks of its own (it ranks against an
+    # immutable policy snapshot).  None keeps the pristine
+    # pop-LRU-first path, bit-identical to pre-tiering behavior (the
+    # parity oracle; docs/tiering.md).
+    eviction_policy: Optional[object] = None
 
 
 @dataclass

@@ -16,8 +16,19 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("kvcache.scorer")
 
 LONGEST_PREFIX_MATCH = "longest-prefix-match"
+
+# Tiers absent from the weight table score this (the most-valuable
+# weight): unknown > known keeps new tier strings from zeroing scores
+# on old deployments, at the cost of over-valuing them until the
+# deployment learns the tier.  Logged once per unknown tier name —
+# demotion events introduce new tier strings to fleets whose scorer
+# config predates them (docs/configuration.md §Scoring).
+UNKNOWN_TIER_WEIGHT = 1.0
 
 
 @dataclass(frozen=True)
@@ -113,6 +124,9 @@ class LongestPrefixScorer:
         for name, weight in self.tier_weights.items():
             self._weight_to_tier.setdefault(weight, name)
         self._default_tier = self._weight_to_tier.get(1.0, "other")
+        # Unknown tiers warn ONCE per tier name (set adds are
+        # GIL-atomic; a racy duplicate log is harmless).
+        self._warned_tiers: set = set()
         # Per-snapshot weight resolution, keyed on entry-tuple IDENTITY
         # (the in-memory index hands out one cached snapshot tuple per
         # pod cache until it mutates, so steady-state requests re-see
@@ -140,7 +154,9 @@ class LongestPrefixScorer:
         best: Dict[str, float] = {}
         for entry in pods:
             pod = entry.pod_identifier
-            weight = weights.get(entry.device_tier, 1.0)
+            weight = weights.get(entry.device_tier)
+            if weight is None:
+                weight = self._unknown_tier_weight(entry.device_tier)
             prev = best.get(pod)
             if prev is None or weight > prev:
                 best[pod] = weight
@@ -156,17 +172,36 @@ class LongestPrefixScorer:
     ) -> tuple:
         """(max weight, its tier) for one pod's entries on one block.
         ``explain`` resolves tiers through here; ``score``/``advance``
-        inline the same ``tier_weights.get(tier, 1.0)`` resolution on
-        the hot loop — the explain≡score property test pins the two
-        against drifting."""
+        resolve through ``_resolve`` — both route unknown tiers
+        through the same warn-once ``_unknown_tier_weight`` fallback,
+        and the explain≡score property test pins the two against
+        drifting."""
         best, tier = 0.0, None
         for entry in entries:
             if entry.pod_identifier != pod_id:
                 continue
-            weight = self.tier_weights.get(entry.device_tier, 1.0)
+            weight = self.tier_weights.get(entry.device_tier)
+            if weight is None:
+                weight = self._unknown_tier_weight(entry.device_tier)
             if tier is None or weight > best:
                 best, tier = weight, entry.device_tier
         return best, tier
+
+    def _unknown_tier_weight(self, tier: str) -> float:
+        """Fallback for tiers absent from the weight table: score
+        UNKNOWN_TIER_WEIGHT, logging once per tier name so a fleet
+        rollout that introduces a new medium string is visible in the
+        indexer's logs instead of silently shifting scores."""
+        if tier not in self._warned_tiers:
+            self._warned_tiers.add(tier)
+            logger.warning(
+                "unknown device tier %r in index entries: scoring with "
+                "fallback weight %s; add it to ScorerConfig.tier_configs "
+                "to weight it deliberately (docs/configuration.md)",
+                tier,
+                UNKNOWN_TIER_WEIGHT,
+            )
+        return UNKNOWN_TIER_WEIGHT
 
     def begin(
         self, track_tiers: bool = False, track_deaths: bool = False

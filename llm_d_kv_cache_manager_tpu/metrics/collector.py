@@ -263,6 +263,47 @@ class KVCacheMetrics:
             ("kind",),
             registry=self.registry,
         )
+        # Predictive tiering (tiering/; docs/tiering.md).
+        self.tiering_demotions = Counter(
+            f"{_NAMESPACE}_tiering_demotions_total",
+            "Proactive block-group demotions by transition "
+            "(hbm_to_host / host_to_storage).",
+            ("transition",),
+            registry=self.registry,
+        )
+        self.tiering_demotion_bytes = Counter(
+            f"{_NAMESPACE}_tiering_demotion_bytes_total",
+            "Bytes moved down the memory ladder by proactive demotion, "
+            "by transition.",
+            ("transition",),
+            registry=self.registry,
+        )
+        self.tiering_advice = Counter(
+            f"{_NAMESPACE}_tiering_advice_total",
+            "Compute-or-load advisor decisions by action "
+            "(load / recompute / hybrid).",
+            ("action",),
+            registry=self.registry,
+        )
+        self.tiering_evictions = Counter(
+            f"{_NAMESPACE}_tiering_policy_evictions_total",
+            "Eviction victims chosen by the predictive policy, by "
+            "backend and mode (predicted: a reuse prediction ranked the "
+            "sample; fallback_lru: no prediction known, LRU-proxy order).",
+            ("backend", "mode"),
+            registry=self.registry,
+        )
+        self.tiering_readback_rtt = Gauge(
+            f"{_NAMESPACE}_tiering_readback_rtt_seconds",
+            "EWMA of observed offload load-job latency (submit to "
+            "harvest) feeding the compute-or-load advisor.",
+            registry=self.registry,
+        )
+        self.tiering_snapshot_age = Gauge(
+            f"{_NAMESPACE}_tiering_snapshot_age_seconds",
+            "Age of the policy feed's current prediction snapshot.",
+            registry=self.registry,
+        )
         # Per-stage latencies fed by the tracing subsystem (obs/trace.py):
         # every span of a sampled trace lands here under its span name, so
         # the aggregate view and the per-request flight-recorder view

@@ -20,7 +20,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from llm_d_kv_cache_manager_tpu.utils import lockorder
+from llm_d_kv_cache_manager_tpu.utils import lockorder, victim
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("offload.host_tier")
@@ -40,9 +40,16 @@ class HostTierCache:
         self,
         max_bytes: int = DEFAULT_BUDGET_BYTES,
         on_evict: Optional["callable"] = None,
+        eviction_policy: Optional[object] = None,
     ) -> None:
         self.max_bytes = max_bytes
         self._on_evict = on_evict
+        # Predictive eviction ranking (tiering/eviction.py): same
+        # contract as CostAwareIndexConfig.eviction_policy — called
+        # under our lock with an LRU-ordered (file_hash, nbytes)
+        # sample, takes no locks of its own.  None = pristine
+        # pop-LRU-first (the parity oracle).
+        self._eviction_policy = eviction_policy
         self._entries: "OrderedDict[int, np.ndarray]" = OrderedDict()  # guarded-by: _lock
         self._bytes = 0  # guarded-by: _lock
         # Leaf lock: on_evict deliberately fires OUTSIDE it, so no
@@ -73,14 +80,43 @@ class HostTierCache:
                 self._bytes -= old.nbytes
             self._entries[file_hash] = group
             self._bytes += nbytes
+            policy = self._eviction_policy
             while self._bytes > self.max_bytes:
-                evicted_hash, evicted = self._entries.popitem(last=False)
+                if policy is None:
+                    evicted_hash, evicted = self._entries.popitem(
+                        last=False
+                    )
+                else:
+                    evicted_hash = self._select_victim_locked(
+                        policy, file_hash
+                    )
+                    evicted = self._entries.pop(evicted_hash)
                 self._bytes -= evicted.nbytes
                 evicted_hashes.append(evicted_hash)
         if self._on_evict is not None:
             for evicted_hash in evicted_hashes:
                 self._on_evict(evicted_hash)
         return True
+
+    def _select_victim_locked(self, policy, incoming_hash: int) -> int:
+        """Predictive victim over an LRU-ordered sample; the group
+        just inserted is never its own victim (the budget loop would
+        livelock admitting and evicting the same entry).  The shared
+        guard (utils/victim.py) bounds-checks the policy's answer and
+        falls back to the LRU-first victim on any failure."""
+        sample = []
+        limit = victim.sample_limit(policy)
+        for file_hash, group in self._entries.items():
+            if file_hash == incoming_hash:
+                continue
+            sample.append((file_hash, group.nbytes))
+            if len(sample) >= limit:
+                break
+        if not sample:
+            # Only the incoming entry remains; it must go (same as the
+            # pristine path when the budget cannot hold one group).
+            return incoming_hash
+        return sample[victim.guarded_select(policy, sample, logger)][0]
 
     def get(self, file_hash: int) -> Optional[np.ndarray]:
         """Fetch + refresh recency; None on miss."""

@@ -78,6 +78,7 @@ class TPUOffloadConnector:
         spec: TPUOffloadSpec,
         pool: KVCachePool,
         event_sink: Optional[StoreEventSink] = None,
+        policy_engine=None,
     ) -> None:
         if pool.config.block_size != spec.device_block_size:
             raise ValueError(
@@ -121,13 +122,31 @@ class TPUOffloadConnector:
         self.staging_budget = StagingBudget(
             int(spec.max_staging_memory_gb * (1 << 30))
         )
+        # Predictive tiering (tiering/engine.py): when attached, the
+        # host tier evicts by predicted-next-use x byte-cost and every
+        # load completion feeds the compute-or-load RTT estimator.
+        self.policy_engine = policy_engine
+        host_eviction_policy = None
+        rtt_observer = None
+        if policy_engine is not None:
+            host_eviction_policy = policy_engine.eviction_policy(
+                backend="host_tier"
+            )
+            rtt_observer = policy_engine.advisor.observe_load
+            if policy_engine.advisor.config.bytes_per_block <= 0:
+                policy_engine.advisor.config.bytes_per_block = (
+                    pool.block_nbytes
+                )
         self.host_cache = None
         if spec.host_cache_bytes > 0:
             from llm_d_kv_cache_manager_tpu.offload.host_tier import (
                 HostTierCache,
             )
 
-            self.host_cache = HostTierCache(spec.host_cache_bytes)
+            self.host_cache = HostTierCache(
+                spec.host_cache_bytes,
+                eviction_policy=host_eviction_policy,
+            )
         self.store_handler = DeviceToStorageHandler(
             pool,
             self.engine,
@@ -142,6 +161,7 @@ class TPUOffloadConnector:
             self.file_mapper,
             host_cache=self.host_cache,
             staging_budget=self.staging_budget,
+            rtt_observer=rtt_observer,
         )
 
     def get_manager(self) -> SharedStorageOffloadManager:

@@ -647,6 +647,9 @@ class TPUSharedStorageOffloadingSpec(_OffloadingSpec):
         self._handlers: Optional[
             Tuple[TPUToStorageHandler, StorageToTPUHandler]
         ] = None
+        # Exact host bytes of one full file buffer, set by the handler
+        # build (the staging clamp's unit; docs/configuration.md §8).
+        self.file_buffer_nbytes: Optional[int] = None
 
     @staticmethod
     def _extra_config(vllm_config) -> dict:
@@ -684,11 +687,23 @@ class TPUSharedStorageOffloadingSpec(_OffloadingSpec):
             )
         kernel_per_block = self.device_block_size // kernel_block_size
 
+        # Staging-budget sizing semantics (docs/configuration.md §8,
+        # decided in the tiering PR, retiring the seed xfail): the
+        # thread clamp and the runtime budget both count the EXACT
+        # host bytes of one full block-major file buffer —
+        # blocks_per_file x kernel_blocks_per_block x the sum of every
+        # view's per-kernel-block bytes — the same number
+        # ``_job_nbytes`` charges per file at submit time.  The seed
+        # test's nominal "16KB per file" figure double-counted K/V and
+        # dtype width; nominal figures drift, the allocated buffer
+        # cannot.  Each I/O thread stages at most one file buffer, so
+        # threads clamp to max(1, budget // file_buffer_nbytes).
         file_bytes = (
             sum(view.block_nbytes for view in views)
             * kernel_per_block
             * self.blocks_per_file
         )
+        self.file_buffer_nbytes = file_bytes
         budget_bytes = int(self.max_staging_memory_gb * (1 << 30))
         threads = min(
             self.threads_per_chip,
@@ -698,10 +713,10 @@ class TPUSharedStorageOffloadingSpec(_OffloadingSpec):
         if file_bytes * threads > budget_bytes:
             threads = max(1, budget_bytes // file_bytes)
             logger.warning(
-                "clamped I/O threads to %d: file buffer %d MB x threads "
-                "exceeds max_staging_memory_gb=%.1f",
+                "clamped I/O threads to %d: file buffer %d bytes x "
+                "threads exceeds max_staging_memory_gb=%.1f",
                 threads,
-                file_bytes >> 20,
+                file_bytes,
                 self.max_staging_memory_gb,
             )
         engine = OffloadEngine(n_threads=int(threads))

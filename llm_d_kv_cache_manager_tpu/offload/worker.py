@@ -132,6 +132,10 @@ class _HandlerBase:
         # Submit-to-harvest, same single-submitter discipline as the
         # other per-job dicts here.
         self._job_traces: Dict[int, Tuple[object, float]] = {}
+        # Unconditional io-start stamps (every job, traced or not):
+        # the compute-or-load advisor's RTT estimator needs real
+        # submit->harvest latencies, not just the sampled ones.
+        self._io_started: Dict[int, float] = {}
 
     def _trace_submit(self, name: str, job_id: int, n_blocks: int):
         """Sampled trace for one offload job; None when unsampled."""
@@ -142,8 +146,18 @@ class _HandlerBase:
         return job_trace
 
     def _trace_io_start(self, job_id: int, job_trace) -> None:
+        now = time.perf_counter()
+        self._io_started[job_id] = now
         if job_trace is not None:
-            self._job_traces[job_id] = (job_trace, time.perf_counter())
+            self._job_traces[job_id] = (job_trace, now)
+
+    def _io_elapsed(self, job_id: int) -> Optional[float]:
+        """Submit->harvest seconds for a completing job (None for
+        unknown jobs); call exactly once per completion."""
+        started = self._io_started.pop(job_id, None)
+        if started is None:
+            return None
+        return time.perf_counter() - started
 
     def _trace_finish(self, job_id: int, status: JobStatus) -> None:
         """Close the job's io span at harvest.  The io span covers
@@ -253,6 +267,7 @@ class DeviceToStorageHandler(_HandlerBase):
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
         self._budget_release(job_id)
         self._trace_finish(job_id, status)
+        self._io_elapsed(job_id)  # drop the stamp (store side unused)
         hashes, nbytes = self._job_hashes.pop(job_id, (None, 0))
         if hashes is None:
             # A completion this handler never submitted (or one already
@@ -281,11 +296,21 @@ class StorageToDeviceHandler(_HandlerBase):
     With a ``host_cache``, resident groups are served from host DRAM
     (memcpy, no file I/O); only the cache misses go to the engine."""
 
-    def __init__(self, *args, host_cache=None, staging_budget=None):
+    def __init__(
+        self, *args, host_cache=None, staging_budget=None,
+        rtt_observer=None,
+    ):
         super().__init__(*args, staging_budget=staging_budget)
         self._host_cache = host_cache
-        # job_id -> (device_block_ids, host buffers awaiting scatter)
-        self._pending: Dict[int, Tuple[List[int], List[np.ndarray]]] = {}
+        # Compute-or-load feed (tiering/advisor.py): called with
+        # (payload bytes, submit->harvest seconds) on every successful
+        # load so the advisor's RTT model tracks the real path.
+        self._rtt_observer = rtt_observer
+        # job_id -> (device_block_ids, host buffers awaiting scatter,
+        # bytes the engine reads from files — excludes host-tier hits)
+        self._pending: Dict[
+            int, Tuple[List[int], List[np.ndarray], int]
+        ] = {}
 
     def transfer_async(
         self, job_id: int, groups: Sequence[FileBlockGroup]
@@ -327,7 +352,12 @@ class StorageToDeviceHandler(_HandlerBase):
                 all_ids.extend(ids)
             stage.set_attr("files", len(paths))
             stage.set_attr("host_tier_hits", len(buffers) - len(file_buffers))
-        self._pending[job_id] = (all_ids, buffers)
+        # file_nbytes = what the engine actually reads from storage;
+        # the RTT observer must see ONLY these bytes (a host-tier-hit-
+        # heavy job pairs a near-zero io time with its full payload,
+        # which would collapse the advisor's per-byte cost estimate).
+        file_nbytes = sum(buffer.nbytes for buffer in file_buffers)
+        self._pending[job_id] = (all_ids, buffers, file_nbytes)
         self._trace_io_start(job_id, job_trace)
         # Zero-file jobs still register so get_finished reports them.
         self.engine.load(job_id, paths, file_buffers)
@@ -338,6 +368,7 @@ class StorageToDeviceHandler(_HandlerBase):
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
         self._budget_release(job_id)
         self._trace_finish(job_id, status)
+        io_seconds = self._io_elapsed(job_id)
         pending = self._pending.pop(job_id, None)
         METRICS.offload_jobs.labels("load", status.name.lower()).inc()
         if pending is None:
@@ -353,10 +384,22 @@ class StorageToDeviceHandler(_HandlerBase):
             return status
         if status != JobStatus.SUCCEEDED:
             return status
-        block_ids, buffers = pending
+        block_ids, buffers, file_nbytes = pending
         host = np.concatenate([np.moveaxis(b, 0, 1) for b in buffers], axis=1)
         METRICS.offload_bytes.labels("load").inc(
             sum(buffer.nbytes for buffer in buffers)
         )
+        if (
+            self._rtt_observer is not None
+            and io_seconds is not None
+            and file_nbytes > 0
+        ):
+            # Only real file I/O informs the readback cost model: a
+            # host-tier-served job's near-zero io time says nothing
+            # about storage bandwidth.
+            try:
+                self._rtt_observer(file_nbytes, io_seconds)
+            except Exception:  # noqa: BLE001 — advisory feed only
+                logger.exception("rtt observer failed")
         self.pool.scatter_from_host(block_ids, host)
         return status

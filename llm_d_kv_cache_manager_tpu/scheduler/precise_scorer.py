@@ -110,6 +110,12 @@ class PrecisePrefixCacheScorerConfig:
     # the reference lets entries linger and rebuild from live events,
     # which is the right call for brief pod blips.
     purge_index_on_expiry: bool = False
+    # Attach a predictive-tiering PolicyEngine (tiering/engine.py) to
+    # the embedded indexer: the scoring stream feeds its PolicyFeed
+    # and embedding schedulers can read compute-or-load advice from
+    # ``scorer.policy_engine.advisor``.  Env-configured
+    # (TIERING_* knobs, docs/tiering.md).
+    tiering: bool = False
 
 
 # ------------------------------- the scorer -------------------------------
@@ -124,6 +130,15 @@ class PrecisePrefixCacheScorer:
         self.config = config or PrecisePrefixCacheScorerConfig()
         self.indexer = indexer or Indexer(self.config.indexer_config)
         self.indexer.run()
+
+        self.policy_engine = None
+        if self.config.tiering:
+            from llm_d_kv_cache_manager_tpu.tiering import PolicyEngine
+
+            self.policy_engine = PolicyEngine(
+                ledger=self.indexer.cache_stats
+            )
+            self.indexer.set_policy_engine(self.policy_engine)
 
         self.events_pool = Pool(
             self.indexer.kv_block_index,
@@ -154,6 +169,8 @@ class PrecisePrefixCacheScorer:
             self._subscriptions.stop_sweeper()
         self.subscribers.shutdown()
         self.events_pool.shutdown()
+        if self.policy_engine is not None:
+            self.policy_engine.close()
         self.indexer.shutdown()
 
     # -- subscriber lifecycle --

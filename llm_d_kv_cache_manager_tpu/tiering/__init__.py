@@ -1,0 +1,74 @@
+"""Predictive tiering: the policy brain between analytics and the
+offload/index planes.
+
+PRs 1-7 built measurement — the hit-attribution ledger's per-family
+reuse EWMA, per-tier score explain, offload job spans, the bench's
+readback RTT — but nothing *decided* anything with it: eviction ranked
+on recency alone, blocks never moved down the memory ladder until
+pressure forced them, and the scheduler never asked "load the
+offloaded KV or just recompute it?".  This package turns those signals
+into decisions, behind one :class:`PolicyEngine`:
+
+* :mod:`policy_feed` — the stable contract exporting per-family reuse
+  predictions from the cachestats ledger (plus the hash-chain
+  clustering signal per HashEvict), consumed as immutable snapshots so
+  policy reads never take analytics locks;
+* :mod:`eviction` — predicted-next-use x byte-cost eviction ranking,
+  plugged into ``CostAwareMemoryIndex`` and ``HostTierCache`` (LRU
+  remains the escape hatch and the parity oracle);
+* :mod:`demotion` — the proactive HBM -> host -> shared_storage
+  demotion worker, publishing ``medium``-tagged KVEvents so the
+  scorer's tier weights finally rank real residency;
+* :mod:`advisor` — the compute-or-load advisor: measured readback RTT
+  vs the model's prefill rate, per prefix chunk, returning
+  load / recompute / hybrid-overlap.
+
+See docs/tiering.md for the contract, the eviction formula, the
+demotion state machine, and the compute-or-load decision rule.
+"""
+
+from llm_d_kv_cache_manager_tpu.tiering.advisor import (
+    Advice,
+    AdvisorConfig,
+    ComputeOrLoadAdvisor,
+    RttEstimator,
+)
+from llm_d_kv_cache_manager_tpu.tiering.demotion import (
+    DemotionConfig,
+    DemotionWorker,
+    PodTierState,
+    pool_event_sink,
+)
+from llm_d_kv_cache_manager_tpu.tiering.engine import (
+    PolicyEngine,
+    TieringConfig,
+)
+from llm_d_kv_cache_manager_tpu.tiering.eviction import (
+    LRU_POLICY,
+    PredictiveEvictionPolicy,
+)
+from llm_d_kv_cache_manager_tpu.tiering.policy_feed import (
+    PolicyFeed,
+    PolicyFeedConfig,
+    PolicySnapshot,
+    ReusePrediction,
+)
+
+__all__ = [
+    "Advice",
+    "AdvisorConfig",
+    "ComputeOrLoadAdvisor",
+    "DemotionConfig",
+    "DemotionWorker",
+    "LRU_POLICY",
+    "PodTierState",
+    "PolicyEngine",
+    "PolicyFeed",
+    "PolicyFeedConfig",
+    "PolicySnapshot",
+    "PredictiveEvictionPolicy",
+    "ReusePrediction",
+    "RttEstimator",
+    "TieringConfig",
+    "pool_event_sink",
+]

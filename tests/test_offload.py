@@ -234,6 +234,63 @@ class TestStoreLoadRoundtrip:
         connector.close()
 
 
+class TestRttObserver:
+    def test_observer_sees_only_file_bytes(self, tmp_path):
+        """The compute-or-load RTT feed must be priced on what the
+        engine actually reads from storage: a host-tier-served group
+        pairs near-zero io time with its payload, which would collapse
+        the advisor's per-byte estimate (review finding, pinned)."""
+        from llm_d_kv_cache_manager_tpu.offload.host_tier import (
+            HostTierCache,
+        )
+        from llm_d_kv_cache_manager_tpu.offload.worker import (
+            StorageToDeviceHandler,
+        )
+
+        connector, pool = make_connector(tmp_path)
+        block_ids = [1, 2, 3, 4]
+        fill_pool_blocks(pool, block_ids)
+        connector.store_handler.transfer_async(
+            1, group_blocks_per_file([0xA, 0xB], block_ids, 2)
+        )
+        assert connector.store_handler.wait(1) == JobStatus.SUCCEEDED
+
+        # Host cache holds ONLY group 0xA; 0xB must come from its file.
+        group_a = np.ascontiguousarray(
+            np.moveaxis(pool.gather_to_host([1, 2]), 1, 0)
+        )
+        cache = HostTierCache(1 << 20)
+        assert cache.put(0xA, group_a)
+        observed = []
+        loader = StorageToDeviceHandler(
+            pool,
+            connector.engine,
+            connector.file_mapper,
+            host_cache=cache,
+            rtt_observer=lambda nbytes, s: observed.append((nbytes, s)),
+        )
+        loader.transfer_async(
+            5, group_blocks_per_file([0xA, 0xB], [20, 21, 22, 23], 2)
+        )
+        assert loader.wait(5) == JobStatus.SUCCEEDED
+        assert len(observed) == 1
+        nbytes, seconds = observed[0]
+        assert nbytes == group_a.nbytes  # one group's file bytes only
+        assert seconds > 0
+
+        # A fully host-served job contributes NO observation.
+        group_b = np.ascontiguousarray(
+            np.moveaxis(pool.gather_to_host([3, 4]), 1, 0)
+        )
+        assert cache.put(0xB, group_b)
+        loader.transfer_async(
+            6, group_blocks_per_file([0xA, 0xB], [24, 25, 26, 27], 2)
+        )
+        assert loader.wait(6) == JobStatus.SUCCEEDED
+        assert len(observed) == 1
+        connector.close()
+
+
 class TestManager:
     def test_lookup_consecutive(self, tmp_path):
         connector, pool = make_connector(tmp_path)

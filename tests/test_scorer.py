@@ -68,6 +68,42 @@ class TestLongestPrefixScorer:
         mapping = {1: [entry("a", "mystery-tier")]}
         assert scorer.score([1], mapping) == {"a": 1.0}
 
+    def test_unknown_tier_logs_once_per_tier(self):
+        """Demotion events introduce new tier strings to deployments
+        whose weight table predates them: the fallback must be LOUD
+        exactly once per tier name, never per block (the satellite's
+        regression pin; docs/configuration.md §5).  The kvtpu root
+        logger does not propagate, so the capture handler attaches to
+        the scorer's logger directly."""
+        import logging
+
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = Capture(level=logging.WARNING)
+        target = logging.getLogger("kvtpu.kvcache.scorer")
+        target.addHandler(handler)
+        try:
+            scorer = make_scorer()
+            mapping = {
+                1: [entry("a", "mystery-tier")],
+                2: [entry("a", "mystery-tier")],
+                3: [entry("a", "second-mystery")],
+            }
+            # score() resolves via _resolve; explain() via _best_entry
+            # — both route through the warn-once fallback.
+            assert scorer.score([1, 2, 3], mapping) == {"a": 3.0}
+            scorer.explain([1, 2, 3], mapping)
+        finally:
+            target.removeHandler(handler)
+        warnings = [m for m in records if "unknown device tier" in m]
+        assert len(warnings) == 2, warnings
+        assert any("mystery-tier" in w for w in warnings)
+        assert any("second-mystery" in w for w in warnings)
+
     def test_gpu_aliases_supported(self):
         scorer = make_scorer()
         mapping = {1: [entry("a", "gpu"), entry("b", "cpu")]}

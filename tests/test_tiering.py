@@ -1,0 +1,857 @@
+"""Predictive tiering: feed, eviction ranking, demotion, advisor.
+
+Covers the tentpole's acceptance properties:
+
+* the PolicyFeed contract: family predictions from the ledger EWMA,
+  the hash-chain cluster fallback for families seen once, overdue
+  back-off, bounded key map, lock-free snapshots;
+* predictive eviction: predicted-next-use x byte-cost ranking in
+  ``CostAwareMemoryIndex`` and ``HostTierCache``; ``policy=None`` and
+  the LRU escape-hatch policy are bit-identical to the pristine
+  pop-LRU-first order (the parity oracle);
+* the demotion worker's state machine (hbm -> host -> shared_storage),
+  the cold-but-reusable gate, the pressure watermark, and the
+  per-cycle move budget;
+* the demotion ROUND TRIP, end to end through the kvevents pool (not
+  unit-mocked): demote -> medium-tagged BlockStored/BlockRemoved ->
+  index tier update -> scorer weight change -> ledger per-tier split;
+* the compute-or-load advisor: decision rule, hybrid <= min(pure),
+  the advice flip when the RTT estimator inflates, estimator feeds;
+* the /debug/tiering endpoint and the /healthz tiering block.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.request
+
+import numpy as np
+
+from llm_d_kv_cache_manager_tpu.analytics.ledger import (
+    CacheStatsLedger,
+    LedgerConfig,
+)
+from llm_d_kv_cache_manager_tpu.api.http_service import serve
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    CostAwareIndexConfig,
+    PodEntry,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    Message,
+    Pool,
+    PoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.offload.host_tier import HostTierCache
+from llm_d_kv_cache_manager_tpu.tiering import (
+    Advice,
+    AdvisorConfig,
+    ComputeOrLoadAdvisor,
+    DemotionConfig,
+    DemotionWorker,
+    LRU_POLICY,
+    PodTierState,
+    PolicyEngine,
+    PolicyFeed,
+    PolicyFeedConfig,
+    PredictiveEvictionPolicy,
+    RttEstimator,
+    TieringConfig,
+    pool_event_sink,
+)
+from llm_d_kv_cache_manager_tpu.tiering.demotion import HBM, HOST
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import Encoding
+
+MODEL = "tiering-model"
+BLOCK_SIZE = 4
+
+
+class WordTokenizer:
+    """Deterministic whitespace tokenizer: 'tN' -> N."""
+
+    def type(self) -> str:
+        return "word"
+
+    def encode(self, prompt, model_name, add_special_tokens=True):
+        tokens, offsets, pos = [], [], 0
+        for word in prompt.split(" "):
+            tokens.append(int(word[1:]))
+            offsets.append((pos, pos + len(word)))
+            pos += len(word) + 1
+        return Encoding(tokens, offsets)
+
+
+def prompt_of(tokens) -> str:
+    return " ".join(f"t{t}" for t in tokens)
+
+
+def make_feed(ledger=None, **kw) -> PolicyFeed:
+    return PolicyFeed(ledger=ledger, config=PolicyFeedConfig(**kw))
+
+
+def seeded_feed(family=0xF00, ewma=2.0, now=100.0, keys=(1, 2, 3)):
+    """Feed + ledger with one family whose EWMA is ``ewma`` and whose
+    chain keys are ``keys`` (family key last)."""
+    ledger = CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+    chain = list(keys) + [family]
+    ledger.record(family, MODEL, 4, 4, now=now - ewma)
+    ledger.record(family, MODEL, 4, 4, now=now)
+    feed = make_feed(ledger)
+    feed.observe_chain(chain, family, now=now)
+    feed.refresh(now)
+    return feed, ledger
+
+
+# ----------------------------- feed ------------------------------------
+
+
+class TestPolicyFeed:
+    def test_family_prediction_from_ledger_ewma(self):
+        feed, _ = seeded_feed(ewma=2.0, now=100.0)
+        snapshot = feed.snapshot()
+        prediction = snapshot.prediction_for_key(2)
+        assert prediction is not None
+        assert prediction.source == "family"
+        assert prediction.predicted_interarrival_s == 2.0
+        # Half the rhythm elapsed: next use expected in ~1s.
+        assert abs(snapshot.expected_next_use_s(2, 101.0) - 1.0) < 1e-6
+
+    def test_overdue_families_back_off(self):
+        feed, _ = seeded_feed(ewma=2.0, now=100.0)
+        snapshot = feed.snapshot()
+        # 10s past a 2s rhythm: 8s overdue -> expected next use grows
+        # with the silence instead of clamping at "imminent".
+        assert snapshot.expected_next_use_s(2, 110.0) == 8.0
+
+    def test_cluster_fallback_for_single_shot_family(self):
+        """A family seen once has no EWMA; it inherits its coarse
+        prefix cluster's rhythm (the HashEvict signal: chained keys
+        ARE locality-sensitive hashes of the prefix)."""
+        ledger = CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+        feed = make_feed(ledger, cluster_blocks=2)
+        # Two sibling families share chain keys up to block 2 (same
+        # cluster key at index 1), arriving 3s apart.
+        ledger.record(0xA, MODEL, 4, 4, now=10.0)
+        feed.observe_chain([100, 200, 0xA], 0xA, now=10.0)
+        ledger.record(0xB, MODEL, 4, 4, now=13.0)
+        feed.observe_chain([100, 200, 0xB], 0xB, now=13.0)
+        snapshot = feed.refresh(13.0)
+        # Neither family has its own EWMA (each seen once)...
+        assert ledger.predicted_interarrival_s(0xA) is None
+        # ...but both predict through the cluster's 3s rhythm.
+        for family in (0xA, 0xB):
+            prediction = snapshot.predictions.get(family)
+            assert prediction is not None, hex(family)
+            assert prediction.source == "cluster"
+            assert prediction.predicted_interarrival_s == 3.0
+
+    def test_family_history_beats_cluster(self):
+        feed, _ = seeded_feed(ewma=2.0, now=100.0)
+        # live query agrees with the snapshot
+        prediction = feed.prediction(0xF00, now=100.0)
+        assert prediction.source == "family"
+        assert prediction.predicted_interarrival_s == 2.0
+
+    def test_key_map_is_bounded_lru(self):
+        feed = make_feed(None, key_map_size=8)
+        for i in range(4):
+            feed.observe_chain([i * 10, i * 10 + 1], i, now=float(i))
+        # 8 keys resident; the next chain evicts the oldest pair.
+        feed.observe_chain([900, 901], 99, now=10.0)
+        snapshot = feed.refresh(10.0)
+        assert len(snapshot.key_family) == 8
+        assert snapshot.family_of(0) is None  # oldest evicted
+        assert snapshot.family_of(900) == 99
+
+    def test_reobserving_resident_keys_evicts_nothing(self):
+        """An at-capacity map re-observing its OWN keys must not evict
+        unrelated entries (review finding: room was reserved before
+        dedup, silently degrading their predictions to the LRU
+        proxy)."""
+        feed = make_feed(None, key_map_size=6)
+        feed.observe_chain([1, 2, 3], 0xA, now=1.0)
+        feed.observe_chain([4, 5, 6], 0xB, now=2.0)
+        # Full map; re-observe family A's chain repeatedly.
+        for _ in range(3):
+            feed.observe_chain([1, 2, 3], 0xA, now=3.0)
+        snapshot = feed.refresh(3.0)
+        assert len(snapshot.key_family) == 6
+        assert snapshot.family_of(4) == 0xB  # untouched survivor
+
+    def test_family_cluster_map_is_bounded(self):
+        feed = make_feed(None, max_families=4)
+        for i in range(10):
+            feed.observe_chain([i * 10, i * 10 + 1], i, now=float(i))
+        with feed._lock:
+            assert len(feed._family_cluster) == 4
+            assert 0 not in feed._family_cluster  # oldest evicted
+            assert 9 in feed._family_cluster
+
+    def test_unknown_key_predicts_none(self):
+        feed, _ = seeded_feed()
+        assert feed.snapshot().expected_next_use_s(0xDEAD, 100.0) is None
+
+    def test_observe_keys_registers_extra_keys(self):
+        feed, _ = seeded_feed(family=0xF00, now=100.0)
+        feed.observe_keys([0xFEED], 0xF00)
+        snapshot = feed.refresh(100.0)
+        assert snapshot.family_of(0xFEED) == 0xF00
+        assert snapshot.expected_next_use_s(0xFEED, 100.5) is not None
+
+    def test_ledger_bulk_export(self):
+        _, ledger = seeded_feed(family=0xF00, ewma=2.0, now=100.0)
+        rows = ledger.reuse_predictions()
+        assert len(rows) == 1
+        family, ewma, last_seen, requests = rows[0]
+        assert family == 0xF00 and ewma == 2.0
+        assert last_seen == 100.0 and requests == 2
+
+
+# ------------------------- eviction ranking ------------------------------
+
+
+class TestPredictiveEvictionPolicy:
+    def test_prediction_overrides_recency(self):
+        """The LRU-oldest entry returns every 2s; a fresher entry's
+        family returns hourly — prediction must evict the fresh one."""
+        feed, ledger = seeded_feed(family=0xF00, ewma=2.0, now=100.0)
+        ledger.record(0xC01D, MODEL, 4, 4, now=100.0 - 3600.0)
+        ledger.record(0xC01D, MODEL, 4, 4, now=100.0)
+        feed.observe_chain([7, 8, 0xC01D], 0xC01D, now=100.0)
+        feed.refresh(100.0)
+        policy = PredictiveEvictionPolicy(feed, backend="test")
+        # Candidates LRU-first: key 2 (2s family) oldest, key 7
+        # (hourly family) newest; equal cost.
+        victim = policy.select_victim([(2, 100), (7, 100)], now=101.0)
+        assert victim == 1
+        assert policy.predicted_choices == 1
+
+    def test_byte_cost_breaks_ties(self):
+        feed, _ = seeded_feed()
+        policy = PredictiveEvictionPolicy(feed, backend="test")
+        # Both unknown: LRU proxy scales by position, but a 100x
+        # byte-cost gap dominates the proxy's 2x position spread.
+        victim = policy.select_victim([(50, 10), (51, 1000)], now=0.0)
+        assert victim == 1
+
+    def test_all_unknown_degrades_toward_lru(self):
+        feed = make_feed(None)
+        policy = PredictiveEvictionPolicy(feed, backend="test")
+        victim = policy.select_victim([(1, 64), (2, 64), (3, 64)], now=0.0)
+        assert victim == 0  # oldest wins on equal cost
+        assert policy.fallback_choices == 1
+
+    def test_lru_escape_hatch_always_picks_first(self):
+        assert LRU_POLICY.select_victim([(9, 1), (8, 999)]) == 0
+
+
+def _random_ops(index, rng, n=400):
+    """Drive a deterministic random add/evict/lookup mix."""
+    for i in range(n):
+        op = rng.random()
+        key = rng.randrange(64)
+        if op < 0.6:
+            index.add(
+                [key * 7 + 1],
+                [key],
+                [PodEntry(f"pod-{rng.randrange(4)}", "hbm")],
+            )
+        elif op < 0.8:
+            index.evict(key * 7 + 1, [PodEntry("pod-0", "hbm")])
+        else:
+            index.lookup([key])
+
+
+class TestCostAwareEvictionPolicy:
+    def _tight_index(self, policy=None) -> CostAwareMemoryIndex:
+        return CostAwareMemoryIndex(
+            CostAwareIndexConfig(
+                max_cost_bytes=2000, eviction_policy=policy
+            )
+        )
+
+    def test_policy_off_parity_is_bit_identical(self):
+        """policy=None and the LRU escape-hatch policy must both
+        reproduce the pristine eviction order exactly — the parity
+        oracle for the policy plumbing."""
+        baseline = self._tight_index(policy=None)
+        hatch = self._tight_index(policy=LRU_POLICY)
+        _random_ops(baseline, random.Random(42))
+        _random_ops(hatch, random.Random(42))
+        assert baseline.dump_entries() == hatch.dump_entries()
+        assert baseline.resident_cost_bytes == hatch.resident_cost_bytes
+
+    def test_predictive_policy_protects_hot_family(self):
+        # Real-clock seed: the index's eviction path stamps its own
+        # time.monotonic(), so the fake 100.0 clock would read as a
+        # massively overdue family.
+        feed, ledger = seeded_feed(
+            family=0xF00, ewma=1.0, now=time.monotonic()
+        )
+        # Key 2 belongs to the 1s-rhythm family; fill the index so
+        # eviction must pick between it and unpredicted keys.
+        policy = PredictiveEvictionPolicy(
+            feed, backend="cost_aware", sample=8
+        )
+        index = self._tight_index(policy=policy)
+        index.add([21], [2], [PodEntry("pod-1", "hbm")])
+        for i in range(30):
+            index.add([1000 + i], [500 + i], [PodEntry("pod-1", "hbm")])
+        # Budget pressure evicted many keys, but never the predicted
+        # hot key 2 (its expected next use is imminent).
+        assert index.lookup([2]), "hot family key was evicted"
+
+    def test_broken_policy_falls_back_to_lru(self):
+        class Broken:
+            sample = 4
+
+            def select_victim(self, candidates, now=None):
+                raise RuntimeError("boom")
+
+        index = self._tight_index(policy=Broken())
+        for i in range(40):
+            index.add([1000 + i], [500 + i], [PodEntry("pod-1", "hbm")])
+        # Evictions happened (budget held) despite the broken policy.
+        assert index.resident_cost_bytes <= 2000
+
+
+class TestHostTierEvictionPolicy:
+    def _group(self, nbytes=256):
+        return np.zeros(nbytes, dtype=np.uint8)
+
+    def test_policy_off_parity(self):
+        baseline = HostTierCache(max_bytes=1024)
+        hatch = HostTierCache(max_bytes=1024, eviction_policy=LRU_POLICY)
+        evicted_a, evicted_b = [], []
+        baseline._on_evict = evicted_a.append
+        hatch._on_evict = evicted_b.append
+        for cache, log in ((baseline, evicted_a), (hatch, evicted_b)):
+            for i in range(8):
+                cache.put(i, self._group())
+        assert evicted_a == evicted_b
+        assert baseline.stats()["entries"] == hatch.stats()["entries"]
+
+    def test_predictive_policy_keeps_hot_group(self):
+        now = time.monotonic()  # real clock: put() stamps its own
+        feed, _ = seeded_feed(family=0xF00, ewma=1.0, now=now)
+        feed.observe_keys([7], 0xF00)
+        feed.refresh(now)
+        policy = PredictiveEvictionPolicy(
+            feed, backend="host_tier", sample=8
+        )
+        cache = HostTierCache(max_bytes=1024, eviction_policy=policy)
+        cache.put(7, self._group())  # the hot group, inserted FIRST
+        for i in range(100, 106):
+            cache.put(i, self._group())
+        # LRU would have evicted 7 (oldest); prediction keeps it.
+        assert cache.contains(7)
+
+
+# ----------------------------- advisor ----------------------------------
+
+
+class TestRttEstimator:
+    def test_cold_estimator_returns_none(self):
+        assert RttEstimator().estimate(1024) is None
+
+    def test_floor_plus_per_byte(self):
+        estimator = RttEstimator(floor_s=0.05)
+        estimator.observe(1 << 20, 0.05 + 0.1)  # 0.1s for 1MB
+        estimate = estimator.estimate(2 << 20)
+        assert abs(estimate - (0.05 + 0.2)) < 1e-6
+
+    def test_ignores_nonpositive_samples(self):
+        estimator = RttEstimator()
+        estimator.observe(0, 1.0)
+        estimator.observe(100, 0.0)
+        assert estimator.stats()["observations"] == 0
+
+
+class TestComputeOrLoadAdvisor:
+    def _advisor(self, per_byte_s=None, prefill=16384.0, **kw) -> ComputeOrLoadAdvisor:
+        advisor = ComputeOrLoadAdvisor(
+            AdvisorConfig(
+                bytes_per_block=1024,
+                block_tokens=16,
+                prefill_tokens_per_s=prefill,
+                **kw,
+            )
+        )
+        if per_byte_s is not None:
+            advisor.observe_load(1 << 20, per_byte_s * (1 << 20))
+        return advisor
+
+    def test_no_rtt_means_recompute(self):
+        advice = self._advisor().advise(64)
+        assert advice.action == "recompute"
+        assert advice.reason == "no-rtt-observations"
+
+    def test_no_prefill_rate_means_load(self):
+        advisor = self._advisor(per_byte_s=1e-9, prefill=0.0)
+        assert advisor.advise(64).action == "load"
+
+    def test_slow_rtt_flips_to_recompute(self):
+        """The smoke gate's property at unit level: inflating the RTT
+        estimator flips the advice away from load."""
+        advisor = self._advisor(per_byte_s=1e-9)
+        fast = advisor.advise(512)
+        assert fast.action in ("load", "hybrid")
+        # Inflate: dominate the EWMA with catastrophic observations.
+        for _ in range(20):
+            advisor.observe_load(1 << 20, 30.0)
+        slow = advisor.advise(512)
+        assert slow.action == "recompute"
+        assert slow.recompute_s < slow.load_s
+
+    def test_hybrid_never_beats_both_pures_dishonestly(self):
+        """hybrid_s = min over k of max(load(k), recompute(n-k)):
+        by construction <= both pure arms; the advisor must report a
+        split consistent with that."""
+        advisor = self._advisor(per_byte_s=3e-6)  # load ~ recompute
+        advice = advisor.advise(512)
+        assert advice.hybrid_s is not None
+        assert advice.hybrid_s <= min(advice.load_s, advice.recompute_s) + 1e-9
+        if advice.action == "hybrid":
+            assert 0 < advice.load_blocks < 512
+
+    def test_hybrid_disabled(self):
+        advisor = self._advisor(per_byte_s=3e-6, hybrid=False)
+        advice = advisor.advise(512)
+        assert advice.hybrid_s is None
+        assert advice.action in ("load", "recompute")
+
+    def test_learned_prefill_rate(self):
+        advisor = ComputeOrLoadAdvisor(
+            AdvisorConfig(bytes_per_block=1024, block_tokens=16)
+        )
+        advisor.observe_prefill(8192, 0.5)
+        assert abs(advisor.prefill_tokens_per_s - 16384.0) < 1e-6
+
+    def test_advice_serializes(self):
+        advice = self._advisor(per_byte_s=1e-9).advise(8)
+        view = advice.to_dict()
+        assert isinstance(advice, Advice)
+        assert view["action"] == advice.action
+        assert view["blocks"] == 8
+
+
+# ----------------------------- demotion ---------------------------------
+
+
+def _make_state(feed, sink=None, capacity=10_000):
+    return PodTierState(
+        capacity_bytes=capacity, event_sink=sink, feed=feed
+    )
+
+
+def _register(state, key, tokens, nbytes=1000, family=None, now=None):
+    state.register_group(
+        key,
+        engine_hashes=[key * 10 + i for i in range(2)],
+        token_ids=tokens,
+        nbytes=nbytes,
+        block_size=BLOCK_SIZE,
+        family=family,
+        now=now,
+    )
+
+
+class TestDemotionWorker:
+    def test_state_machine_hbm_host_storage(self):
+        events = []
+        feed, _ = seeded_feed(family=0xF00, now=100.0)
+        state = _make_state(feed, sink=events.append)
+        _register(
+            state, 1, list(range(8)), family=0xF00,
+            now=time.monotonic() - 500,
+        )
+        worker = DemotionWorker(
+            state,
+            feed,
+            DemotionConfig(
+                demote_host_idle_s=0.0, demote_storage_idle_s=0.0
+            ),
+        )
+        assert worker.run_cycle() == 1
+        assert state.tiers() == {"host": 1}
+        assert worker.run_cycle() == 1
+        assert state.tiers() == {"shared_storage": 1}
+        # Terminal tier: nothing left to demote.
+        assert worker.run_cycle() == 0
+        # Each transition published store-then-remove with the right
+        # mediums.
+        mediums = [
+            (batch[0].medium, batch[1].medium) for batch in events
+        ]
+        assert mediums == [("host", "hbm"), ("shared_storage", "host")]
+
+    def test_cold_but_unpredicted_is_left_alone(self):
+        feed, _ = seeded_feed(family=0xF00, now=100.0)
+        state = _make_state(feed)
+        _register(
+            state, 1, list(range(8)), family=None,
+            now=time.monotonic() - 500,
+        )
+        worker = DemotionWorker(
+            state, feed, DemotionConfig(demote_host_idle_s=0.0)
+        )
+        assert worker.run_cycle() == 0
+        assert state.tiers() == {"hbm": 1}
+
+    def test_pressure_forces_unpredicted_demotion(self):
+        feed, _ = seeded_feed()
+        state = _make_state(feed, capacity=1000)
+        _register(state, 1, list(range(8)), nbytes=900, family=None)
+        worker = DemotionWorker(
+            state,
+            feed,
+            DemotionConfig(
+                demote_host_idle_s=1e9, pressure_watermark=0.85
+            ),
+        )
+        assert state.pressure() == 0.9
+        assert worker.run_cycle() == 1
+        assert state.tiers() == {"host": 1}
+        record = worker.stats()["recent"][0]
+        assert record["forced_by_pressure"] is True
+
+    def test_move_budget_bounds_a_cycle(self):
+        feed, _ = seeded_feed(family=0xF00, now=100.0)
+        state = _make_state(feed)
+        old = time.monotonic() - 500
+        for i in range(10):
+            _register(state, i, list(range(8)), family=0xF00, now=old)
+        worker = DemotionWorker(
+            state,
+            feed,
+            DemotionConfig(
+                demote_host_idle_s=0.0, max_moves_per_cycle=3
+            ),
+        )
+        assert worker.run_cycle() == 3
+        assert state.tiers() == {"hbm": 7, "host": 3}
+
+    def test_coldest_reusable_goes_first(self):
+        """Ranking: the group whose predicted next use is farthest
+        demotes first."""
+        ledger = CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+        feed = make_feed(ledger)
+        now = time.monotonic()
+        for family, ewma in ((0xA, 1.0), (0xB, 900.0)):
+            ledger.record(family, MODEL, 4, 4, now=now - ewma)
+            ledger.record(family, MODEL, 4, 4, now=now)
+            feed.observe_chain([family * 100, family], family, now=now)
+        state = _make_state(feed)
+        _register(state, 1, list(range(8)), family=0xA, now=now - 50)
+        _register(state, 2, list(range(8)), family=0xB, now=now - 50)
+        worker = DemotionWorker(
+            state,
+            feed,
+            DemotionConfig(
+                demote_host_idle_s=0.0, max_moves_per_cycle=1
+            ),
+        )
+        assert worker.run_cycle() == 1
+        tiers = {
+            key: group.tier for key, group in state._groups.items()
+        }
+        assert tiers[2] == "host"  # the ~15-minute family demoted
+        assert tiers[1] == "hbm"  # the 1s family stayed put
+
+    def test_worker_start_close_idempotent(self):
+        feed, _ = seeded_feed()
+        worker = DemotionWorker(
+            _make_state(feed), feed, DemotionConfig(interval_s=0.05)
+        )
+        worker.start()
+        worker.start()
+        time.sleep(0.12)
+        worker.close()
+        worker.close()
+        assert worker.stats()["cycles"] >= 1
+        assert worker.stats()["running"] is False
+
+    def test_host_cache_rejection_keeps_tier(self):
+        class RejectingCache:
+            def put(self, key, group):
+                return False
+
+        feed, _ = seeded_feed(family=0xF00, now=100.0)
+        state = PodTierState(
+            capacity_bytes=10_000,
+            host_cache=RejectingCache(),
+            feed=feed,
+        )
+        _register(
+            state, 1, list(range(8)), family=0xF00,
+            now=time.monotonic() - 500,
+        )
+        assert state.demote(1, HOST) is False
+        assert state.tiers() == {HBM: 1}
+
+
+# ------------------- demotion round trip (e2e) --------------------------
+
+
+class TestDemotionRoundTrip:
+    """Satellite: demote a block group -> medium-tagged events through
+    the REAL kvevents pool -> index tier update -> scorer weight change
+    -> ledger per-tier hit split.  Nothing mocked below the sink."""
+
+    def _stack(self):
+        ledger = CacheStatsLedger(
+            LedgerConfig(sample_rate=1.0, tier_sample=1)
+        )
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=BLOCK_SIZE
+                ),
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    workers=1, model_name=MODEL
+                ),
+            ),
+            tokenizer=WordTokenizer(),
+            cache_stats_ledger=ledger,
+        )
+        indexer.run()
+        pool = Pool(
+            indexer.kv_block_index,
+            indexer.token_processor,
+            PoolConfig(concurrency=2),
+        )
+        pool.start()
+        return indexer, pool, ledger
+
+    def test_round_trip(self):
+        indexer, pool, ledger = self._stack()
+        try:
+            engine = PolicyEngine(
+                ledger=ledger, config=TieringConfig()
+            )
+            indexer.set_policy_engine(engine)
+            tokens = list(range(1, 33))  # 8 blocks of 4
+            n_blocks = len(tokens) // BLOCK_SIZE
+            prompt = prompt_of(tokens)
+            engine_hashes = [0x9000 + i for i in range(n_blocks)]
+
+            # Seed the chain on pod-1 at hbm through the pool, as the
+            # engine's publisher would.
+            batch = EventBatch(
+                ts=1.0,
+                events=[
+                    BlockStored(
+                        block_hashes=list(engine_hashes),
+                        parent_block_hash=None,
+                        token_ids=tokens,
+                        block_size=BLOCK_SIZE,
+                        medium="hbm",
+                    )
+                ],
+            )
+            pool.add_task(
+                Message(
+                    topic=f"kv@pod-1@{MODEL}",
+                    payload=batch.encode(),
+                    pod_identifier="pod-1",
+                    model_name=MODEL,
+                )
+            )
+            pool.drain()
+
+            # Scored at hbm: full-weight chain, hbm tier split.
+            scores = indexer.get_pod_scores(prompt, MODEL, ["pod-1"])
+            assert scores["pod-1"] == float(n_blocks)
+            ledger.flush_metrics()
+            assert ledger.snapshot()["totals"]["tiers"] == {
+                "hbm": n_blocks
+            }
+
+            # Demote the whole group hbm -> host through the worker;
+            # its events ride the SAME pool path as live traffic.
+            state = PodTierState(
+                capacity_bytes=10_000,
+                event_sink=pool_event_sink(pool, "pod-1", MODEL),
+                feed=engine.feed,
+            )
+            family = ledger.family_key(
+                indexer.token_processor.tokens_to_kv_block_keys(
+                    0, tokens, MODEL
+                ),
+                n_blocks,
+            )
+            state.register_group(
+                0xF11E,
+                engine_hashes=engine_hashes,
+                token_ids=tokens,
+                nbytes=4096,
+                block_size=BLOCK_SIZE,
+                family=family,
+                now=time.monotonic() - 600,
+            )
+            worker = engine.start_demotion(
+                state,
+                DemotionConfig(
+                    demote_host_idle_s=0.0, require_prediction=False
+                ),
+                start=False,
+            )
+            assert worker.run_cycle() == 1
+            pool.drain()
+
+            # Index tier updated: the chain is now host-resident only.
+            request_keys = indexer.token_processor.tokens_to_kv_block_keys(
+                0, tokens, MODEL
+            )
+            found = indexer.kv_block_index.lookup(request_keys)
+            tiers = {
+                entry.device_tier
+                for pods in found.values()
+                for entry in pods
+            }
+            assert tiers == {"host"}
+
+            # Scorer weight change: host weighs 0.8 per block.
+            scores = indexer.get_pod_scores(prompt, MODEL, ["pod-1"])
+            assert abs(scores["pod-1"] - 0.8 * n_blocks) < 1e-9
+
+            # Ledger per-tier split reflects the demotion.
+            ledger.flush_metrics()
+            tiers_total = ledger.snapshot()["totals"]["tiers"]
+            assert tiers_total.get("host") == n_blocks, tiers_total
+            engine.close()
+        finally:
+            pool.shutdown()
+            indexer.shutdown()
+
+
+# ------------------------ engine + debug surface -------------------------
+
+
+class TestPolicyEngineSurface:
+    def test_observe_scored_populates_feed(self):
+        ledger = CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+        engine = PolicyEngine(
+            ledger=ledger,
+            config=TieringConfig(refresh_s=0.0),
+        )
+        ledger.record(0xAB, MODEL, 4, 4)
+        engine.observe_scored([1, 2, 0xAB], 0xAB)
+        status = engine.status()
+        assert status["feed"]["observed_chains"] == 1
+        assert status["feed"]["keys_mapped"] == 3
+
+    def test_debug_endpoint_and_healthz(self):
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=BLOCK_SIZE
+                ),
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    workers=1, model_name=MODEL
+                ),
+            ),
+            tokenizer=WordTokenizer(),
+        )
+        indexer.run()
+        engine = PolicyEngine(ledger=indexer.cache_stats)
+        indexer.set_policy_engine(engine)
+        server = serve(
+            indexer, host="127.0.0.1", port=0, tiering=engine
+        )
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            with urllib.request.urlopen(
+                base + "/debug/tiering", timeout=10
+            ) as response:
+                payload = json.load(response)
+            assert "feed" in payload and "advisor" in payload
+            assert payload["config"]["eviction_sample"] >= 1
+            with urllib.request.urlopen(
+                base + "/healthz", timeout=10
+            ) as response:
+                health = json.load(response)
+            assert "tiering" in health
+            assert "advice_counts" in health["tiering"]
+        finally:
+            server.shutdown()
+            engine.close()
+            indexer.shutdown()
+
+    def test_debug_endpoint_404_when_disabled(self):
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=BLOCK_SIZE
+                ),
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    workers=1, model_name=MODEL
+                ),
+            ),
+            tokenizer=WordTokenizer(),
+        )
+        indexer.run()
+        server = serve(indexer, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            try:
+                urllib.request.urlopen(base + "/debug/tiering", timeout=10)
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 404
+        finally:
+            server.shutdown()
+            indexer.shutdown()
+
+    def test_explain_carries_advice(self):
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=BLOCK_SIZE
+                ),
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    workers=1, model_name=MODEL
+                ),
+            ),
+            tokenizer=WordTokenizer(),
+        )
+        indexer.run()
+        try:
+            engine = PolicyEngine(ledger=indexer.cache_stats)
+            engine.advisor.config.bytes_per_block = 1024
+            engine.advisor.observe_load(1 << 20, 0.01)
+            engine.advisor.observe_prefill(8192, 0.5)
+            indexer.set_policy_engine(engine)
+            tokens = list(range(1, 17))
+            keys = indexer.token_processor.tokens_to_kv_block_keys(
+                0, tokens, MODEL
+            )
+            indexer.kv_block_index.add(
+                keys, keys, [PodEntry("pod-1", "host")]
+            )
+            _, explanation = indexer.get_pod_scores_explained(
+                prompt_of(tokens), MODEL
+            )
+            advice = explanation.get("tiering")
+            assert advice is not None
+            assert advice["pod"] == "pod-1"
+            assert advice["blocks"] == len(keys)
+            assert advice["action"] in ("load", "recompute", "hybrid")
+            engine.close()
+        finally:
+            indexer.shutdown()

@@ -557,7 +557,8 @@ class TestStagingBudget:
         }
         spec = spec_for(
             tmp_path,
-            # Tiny budget: one file buffer is 16KB; budget fits ~2.
+            # Tiny budget: one file buffer is 2KB and each 4-file job
+            # stages 8KB, so the 32KB budget holds ~4 jobs in flight.
             extra={"block_size": 64, "max_staging_memory_gb": 32 / (1 << 20)},
         )
         (_, _, store), _ = spec.get_handlers(caches, {"l0": StandardBackend})
@@ -608,25 +609,31 @@ class TestStagingBudget:
         assert not violations
         assert budget.in_flight_bytes == 0
 
-    @pytest.mark.xfail(
-        reason="seed: the clamp math and this test disagree on the "
-        "file-buffer size (computed 8KB vs the 16KB the budget here "
-        "assumes), so threads clamp to 2, not 1; staging-budget "
-        "sizing semantics need a decision (ROADMAP maintenance)",
-        strict=False,
-    )
     def test_thread_clamp_under_budget(self, tmp_path):
+        """Staging-budget sizing semantics (decided; retires the seed
+        xfail): the clamp unit is the EXACT block-major file buffer —
+        blocks_per_file x kernel_blocks x Σ per-kernel-block view
+        bytes — not a nominal per-file figure.  Here that is
+        4 blocks x (16 x 2 x 4 floats) = 2048 bytes, and a budget of
+        exactly one such buffer must clamp to a single I/O thread
+        regardless of threads_per_chip or host core count
+        (docs/configuration.md §8)."""
         caches = {
             "l0": np.zeros((64, 16, 2, 4), np.float32)
         }
+        file_buffer_nbytes = 4 * (16 * 2 * 4) * 4
         spec = spec_for(
             tmp_path,
             extra={
                 "block_size": 64,
                 "threads_per_chip": 32,
-                # Budget ~= one 16KB file buffer: threads must clamp to 1.
-                "max_staging_memory_gb": 16 / (1 << 20),
+                "max_staging_memory_gb": file_buffer_nbytes / (1 << 30),
             },
         )
         (_, _, store), _ = spec.get_handlers(caches, {"l0": StandardBackend})
+        assert spec.file_buffer_nbytes == file_buffer_nbytes
+        # The clamp unit and the runtime budget unit must agree: what
+        # the budget charges per file at submit time is exactly one
+        # clamp unit.
+        assert store._job_nbytes([[0, 1, 2, 3]]) == file_buffer_nbytes
         assert store.engine.n_threads == 1
