@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke cachestats-smoke tiering-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -85,6 +85,15 @@ cachestats-smoke:
 # flips when the RTT estimator is inflated (docs/tiering.md).
 tiering-smoke:
 	$(CPU_ENV) $(PYTHON) hack/tiering_smoke.py
+
+# Cluster smoke (same invocation as CI's "Cluster smoke" step): 3
+# in-process replicas + a router HTTP service over the RemoteIndex —
+# event-plane traffic routed to slice owners, one replica killed
+# mid-traffic, scores keep flowing, the journal-fed follower takes the
+# slice over WARM (pre-kill scores reproduced exactly), failover
+# visible in /debug/cluster and kvtpu_cluster_* (docs/replication.md).
+cluster-smoke:
+	$(CPU_ENV) $(PYTHON) hack/cluster_smoke.py
 
 # Event-plane smoke (same invocation as CI's "Event-plane smoke"
 # step): consolidated poller over ~64 inproc publishers — throughput

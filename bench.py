@@ -274,6 +274,26 @@ def emit_result(full: dict, probe: dict) -> None:
             "staleness_mean_s": gap.get("staleness_mean_s"),
             "consistency": gap.get("post_resync_consistency"),
         }
+    replica_scaleout = detail.get("replica_scaleout") or {}
+    replica_scaleout_compact = None
+    if replica_scaleout and "cluster_3_replicas" in replica_scaleout:
+        failover = replica_scaleout.get("failover") or {}
+        replica_scaleout_compact = {
+            "single_sps": replica_scaleout["single"].get(
+                "scores_per_sec"
+            ),
+            "cluster1_sps": replica_scaleout["cluster_1_replica"].get(
+                "scores_per_sec"
+            ),
+            "cluster3_sps": replica_scaleout["cluster_3_replicas"].get(
+                "scores_per_sec"
+            ),
+            "parity": replica_scaleout.get("parity"),
+            "pre_kill_hit": failover.get("pre_kill_hit_rate"),
+            "post_kill_hit": failover.get("post_kill_hit_rate"),
+            "dip": failover.get("dip"),
+            "within_envelope": failover.get("within_envelope"),
+        }
     compact = {
         "metric": full["metric"],
         "value": full["value"],
@@ -286,6 +306,7 @@ def emit_result(full: dict, probe: dict) -> None:
         "tiered_churn": tiered_churn_compact,
         "event_storm": event_storm_compact,
         "indexer_restart": detail.get("indexer_restart"),
+        "replica_scaleout": replica_scaleout_compact,
         "elapsed_s": detail.get("elapsed_s"),
         "results": results_path or "WRITE FAILED (stderr has why)",
     }
@@ -296,6 +317,7 @@ def emit_result(full: dict, probe: dict) -> None:
     # the budget is a hard driver contract — shed optional fields
     # before ever printing an oversized last line.
     for key in (
+        "replica_scaleout",
         "indexer_restart",
         "event_storm",
         "tiered_churn",
@@ -755,6 +777,7 @@ class FleetRouter:
         cache_stats_ledger=None,
         exact_tokenize: bool = False,
         pod_factory=None,
+        index_factory=None,
     ) -> None:
         self.strategy = strategy
         # pod_factory(name) lets a regime substitute policy-aware pods
@@ -800,6 +823,12 @@ class FleetRouter:
                 ),
                 tokenizer=WordTokenizer(),
                 cache_stats_ledger=cache_stats_ledger,
+                # index_factory() lets a regime substitute a remote
+                # backend (replica_scaleout: cluster RemoteIndex); None
+                # keeps the config-built in-memory index.
+                kv_block_index=(
+                    index_factory() if index_factory is not None else None
+                ),
             )
             self.indexer.run()
             self.event_pool = Pool(
@@ -2137,6 +2166,224 @@ def maybe_bench_read_path(context: str) -> dict:
     return bench_read_path()
 
 
+# ------------- replica_scaleout: clustered-indexer regime ---------------
+
+
+SCALEOUT_CELL_S = _env_float("KVTPU_BENCH_SCALEOUT_S", 1.0)
+# The pinned failover degradation envelope (docs/replication.md): the
+# post-kill hit rate over the measurement window may dip at most this
+# far below the pre-kill window — the follower's standby slice is warm,
+# so the only lost state is whatever hadn't synced at the kill.
+SCALEOUT_DIP_ENVELOPE = 0.15
+
+
+def bench_replica_scaleout(
+    requests, hashes_list, t_miss: float, t_hit: float,
+    ideal_service: float, cell_seconds: Optional[float] = None,
+) -> dict:
+    """detail.replica_scaleout regime (docs/replication.md): the
+    indexer as an N-replica service, extending ``indexer_restart`` —
+    that regime prices losing the whole index; this one prices losing
+    ONE replica of it.
+
+    Cell 1 (scores/sec): per-request scoring throughput through the
+    REAL read path against a single-process in-memory index, a
+    1-replica cluster (pure RPC-hop overhead), and a 3-replica cluster
+    (in-process replicas over the local transport), with an exact
+    score-parity check across all three — the cluster must never
+    change a routing decision (the same oracle the parity tests pin).
+
+    Cell 2 (failover dip): the fleet stream runs precise routing with
+    the 3-replica cluster (replication followers syncing); halfway, one
+    replica is KILLED mid-traffic.  Engine pods keep their caches —
+    only the index slice moves — so the hit-rate dip between the
+    pre-kill and post-kill windows is the cost of failover, asserted
+    inside the pinned envelope.
+    """
+    import tempfile
+
+    from llm_d_kv_cache_manager_tpu.cluster import LocalCluster
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
+
+    cell_s = SCALEOUT_CELL_S if cell_seconds is None else cell_seconds
+    rng = random.Random(733)
+    pods = [f"pod-{i}" for i in range(NUM_PODS)]
+    out: dict = {"dip_envelope": SCALEOUT_DIP_ENVELOPE}
+
+    # ---- cell 1: multi-replica scores/sec + parity -------------------
+    def new_indexer(index=None) -> Indexer:
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=BLOCK_SIZE
+                ),
+                kvblock_index_config=IndexConfig(),
+                score_memo_size=0,
+                cache_stats=False,
+            ),
+            tokenizer=WordTokenizer(),
+            kv_block_index=index,
+        )
+        indexer.run()
+        return indexer
+
+    convo = [rng.randrange(1, 16384) for _ in range(PREFIX_TOKENS)]
+    prompts: List[str] = []
+    for _ in range(6):
+        convo.extend(
+            rng.randrange(1, 16384) for _ in range(SUFFIX_TOKENS)
+        )
+        prompts.append(" ".join(f"t{t}" for t in convo))
+
+    def seed_index(indexer: Indexer) -> None:
+        keys = indexer.token_processor.tokens_to_kv_block_keys(
+            0, convo, MODEL_NAME
+        )
+        indexer.kv_block_index.add(keys, keys, [PodEntry("pod-0", "hbm")])
+        indexer.kv_block_index.add(keys, keys, [PodEntry("pod-1", "host")])
+
+    def run_cell(indexer: Indexer) -> dict:
+        for prompt in prompts:  # steady-state warmup
+            indexer.get_pod_scores(prompt, MODEL_NAME, pods)
+        latencies: List[float] = []
+        deadline = time.perf_counter() + cell_s
+        i = 0
+        while time.perf_counter() < deadline:
+            t0 = time.perf_counter()
+            indexer.get_pod_scores(
+                prompts[i % len(prompts)], MODEL_NAME, pods
+            )
+            latencies.append(time.perf_counter() - t0)
+            i += 1
+        total = sum(latencies)
+        return {
+            "scores_per_sec": (
+                round(len(latencies) / total, 1) if total else 0.0
+            ),
+            "p50_us": round(float(np.percentile(latencies, 50)) * 1e6, 1),
+            "p99_us": round(float(np.percentile(latencies, 99)) * 1e6, 1),
+            "requests": len(latencies),
+        }
+
+    cluster3 = LocalCluster()
+    cluster1 = LocalCluster(replica_ids=("solo",))
+    single = new_indexer()
+    over3 = new_indexer(cluster3.remote_index)
+    over1 = new_indexer(cluster1.remote_index)
+    try:
+        for indexer in (single, over3, over1):
+            seed_index(indexer)
+        parity_ok = True
+        for prompt in prompts:
+            want = single.get_pod_scores(prompt, MODEL_NAME, pods)
+            if (
+                over3.get_pod_scores(prompt, MODEL_NAME, pods) != want
+                or over1.get_pod_scores(prompt, MODEL_NAME, pods) != want
+            ):
+                parity_ok = False
+        out["single"] = run_cell(single)
+        out["cluster_1_replica"] = run_cell(over1)
+        out["cluster_3_replicas"] = run_cell(over3)
+        out["parity"] = "ok" if parity_ok else "MISMATCH"
+        out["cell_seconds"] = cell_s
+    finally:
+        single.shutdown()
+        over3.shutdown()
+        over1.shutdown()
+        cluster3.close()
+        cluster1.close()
+
+    # ---- cell 2: failover hit-rate dip --------------------------------
+    n = len(requests)
+    half = n // 2
+    window = max(1, half // 2)
+    qps = 0.7 * NUM_PODS / ideal_service
+    arrivals = poisson_arrivals(qps, n, ARRIVAL_SEEDS[0])
+    with tempfile.TemporaryDirectory() as root:
+        cluster = LocalCluster(journal_root=root)
+        fleet = FleetRouter(
+            "precise",
+            with_kv=False,
+            seed=0,
+            index_factory=lambda: cluster.remote_index,
+        )
+        try:
+            pre_hits = 0
+            for i in range(half):
+                _, hit, _, _ = _fleet_step(
+                    fleet, requests[i], hashes_list[i], arrivals[i],
+                    t_miss, t_hit,
+                )
+                if i >= half - window:
+                    pre_hits += hit
+            # Let the event plane and the standby followers catch up,
+            # then kill the replica owning the FIRST request's chain —
+            # guaranteed to hold live slice state.
+            fleet.event_pool.drain()
+            while cluster.sync_followers():
+                pass  # drain bounded polls until every journal is dry
+            ring_before = cluster.membership.ring()
+            victim = ring_before.owner(hashes_list[0][0])
+            # Direct slice-coverage probe: the fleet hit rate can mask
+            # index loss behind the router's affinity fallback, so also
+            # ask the cluster for the victim's own resident keys after
+            # the kill — a warm follower answers ~all of them.
+            victim_dump, _ = cluster.replicas[victim].index.dump_entries()
+            owned_sample = [
+                key
+                for key, _ in victim_dump
+                if ring_before.owner(key) == victim
+            ][:500]
+            cluster.kill(victim)
+            coverage = None
+            if owned_sample:
+                served = cluster.remote_index.lookup(owned_sample)
+                coverage = round(len(served) / len(owned_sample), 3)
+            post_hits = 0
+            for i in range(half, half + window):
+                _, hit, _, _ = _fleet_step(
+                    fleet, requests[i], hashes_list[i], arrivals[i],
+                    t_miss, t_hit,
+                )
+                post_hits += hit
+            pre_rate = round(pre_hits / window, 3)
+            post_rate = round(post_hits / window, 3)
+            dip = round(max(0.0, pre_rate - post_rate), 3)
+            out["failover"] = {
+                "pre_kill_hit_rate": pre_rate,
+                "post_kill_hit_rate": post_rate,
+                "dip": dip,
+                "within_envelope": dip <= SCALEOUT_DIP_ENVELOPE,
+                "slice_coverage_post_kill": coverage,
+                "slice_keys_sampled": len(owned_sample),
+                "coverage_ok": (
+                    coverage is None
+                    or coverage >= 1.0 - SCALEOUT_DIP_ENVELOPE
+                ),
+                "killed_replica": victim,
+                "failovers": cluster.membership.failover_count(),
+                "window_requests": window,
+            }
+        finally:
+            fleet.shutdown()
+            cluster.close()
+    return out
+
+
+def maybe_bench_replica_scaleout(
+    requests, hashes_list, t_miss, t_hit, ideal_service
+) -> dict:
+    """bench_replica_scaleout under the degrade contract."""
+    if _over_budget(reserve_s=50.0):
+        return {"truncated": True}
+    _progress(
+        "replica_scaleout: clustered scores/sec + failover dip"
+    )
+    return bench_replica_scaleout(
+        requests, hashes_list, t_miss, t_hit, ideal_service
+    )
+
+
 # ------------- cache_analytics: ledger-truth + audit-plane regime -------
 
 
@@ -3399,6 +3646,9 @@ def emit_cpu_fallback(device_error: str, probe: dict) -> None:
     indexer_restart = maybe_bench_indexer_restart(
         requests, hashes_list, t_miss, t_hit, ideal_service
     )
+    replica_scaleout = maybe_bench_replica_scaleout(
+        requests, hashes_list, t_miss, t_hit, ideal_service
+    )
     _progress("fallback: virtual-clock matrix (calibrated service times)")
     matrix, matrix_truncated = run_matrix(
         requests, hashes_list, t_miss, t_hit, ideal_service, warmup_idx
@@ -3425,6 +3675,7 @@ def emit_cpu_fallback(device_error: str, probe: dict) -> None:
                 "tiered_churn": tiered_churn,
                 "event_storm": event_storm,
                 "indexer_restart": indexer_restart,
+                "replica_scaleout": replica_scaleout,
                 "requests": len(requests),
                 "elapsed_s": round(_elapsed(), 1),
                 "budget_s": _BUDGET_S,
@@ -3641,6 +3892,13 @@ def main() -> None:
         requests, hashes_list, t_miss, t_hit, ideal_service
     )
 
+    # detail.replica_scaleout: the indexer as an N-replica cluster —
+    # multi-replica scores/sec + parity + the failover hit-rate dip
+    # (docs/replication.md), device-free.
+    replica_scaleout = maybe_bench_replica_scaleout(
+        requests, hashes_list, t_miss, t_hit, ideal_service
+    )
+
     # detail.matrix: 5 strategies x QPS ladder x seeds, virtual clock.
     _progress("detail.matrix: virtual-clock strategy ladder")
     matrix, matrix_truncated = run_matrix(
@@ -3684,6 +3942,7 @@ def main() -> None:
                 "tiered_churn": tiered_churn,
                 "event_storm": event_storm,
                 "indexer_restart": indexer_restart,
+                "replica_scaleout": replica_scaleout,
                 "service_times": "measured",
                 "service_miss_s": round(t_miss, 4),
                 "service_hit_s": round(t_hit, 4),

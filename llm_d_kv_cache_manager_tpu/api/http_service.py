@@ -53,6 +53,8 @@ def _make_handler(
     event_plane_status=None,
     auditor=None,
     tiering=None,
+    replica=None,
+    cluster_status=None,
 ):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -109,7 +111,7 @@ def _make_handler(
         def _error(self, status: int, message: str) -> None:
             self._reply(status, message.encode() + b"\n", "text/plain")
 
-        def _read_json(self) -> Optional[dict]:
+        def _read_body(self) -> Optional[bytes]:
             # A chunked body is never decoded here, so its framing bytes
             # would sit in the buffer and be parsed as the next request
             # line — the keep-alive desync the paths below guard
@@ -161,6 +163,12 @@ def _make_handler(
                 self.close_connection = True
                 return None
             self._body_consumed = True
+            return body
+
+        def _read_json(self) -> Optional[dict]:
+            body = self._read_body()
+            if body is None:
+                return None
             try:
                 obj = json.loads(body)
             except (ValueError, json.JSONDecodeError):
@@ -249,8 +257,29 @@ def _make_handler(
                 self._debug_cachestats(query)
             elif path == "/debug/tiering":
                 self._debug_tiering()
+            elif path == "/debug/cluster":
+                self._debug_cluster()
             else:
                 self._error(404, "not found")
+
+        def _debug_cluster(self):
+            """Read-only cluster plane: membership + ring version +
+            failovers on a router, replica identity + replication
+            follower positions on a replica (docs/replication.md)."""
+            if cluster_status is None:
+                self._error(
+                    404,
+                    "cluster disabled (set CLUSTER_REPLICAS or "
+                    "CLUSTER_SELF)",
+                )
+                return
+            try:
+                payload = cluster_status()
+            except Exception as exc:  # noqa: BLE001 — debug must answer
+                logger.exception("cluster status failed")
+                self._error(500, f"error: {exc}")
+                return
+            self._reply_json(200, payload)
 
         def _debug_tiering(self):
             """Read-only tiering policy plane: feed/snapshot stats,
@@ -372,6 +401,8 @@ def _make_handler(
                     self._purge_pod()
                 elif path == "/admin/snapshot":
                     self._snapshot()
+                elif path == "/replica":
+                    self._replica_call()
                 else:
                     self._error(404, "not found")
             finally:
@@ -397,6 +428,28 @@ def _make_handler(
             host = self.client_address[0]
             return host == "::1" or host.startswith("127.")
 
+        def _replica_call(self):
+            """Replica-serving RPC (docs/replication.md): one CBOR
+            request per POST, dispatched through the cluster replica's
+            method table (``ClusterReplica.handle_wire``).  Mutating
+            like /admin/*, so it shares the admin gate — cluster
+            deployments set ADMIN_TOKEN and give routers the same
+            token; the tokenless default accepts loopback only."""
+            if replica is None:
+                self._error(
+                    404, "not a cluster replica (set CLUSTER_SELF)"
+                )
+                return
+            if not self._admin_allowed():
+                self._error(
+                    403, "replica endpoint: token or loopback only"
+                )
+                return
+            body = self._read_body()
+            if body is None:
+                return
+            self._reply(200, replica.handle_wire(body), "application/cbor")
+
         def _purge_pod(self):
             """Operator recovery: drop every index entry for one pod
             (Index.purge_pod) — e.g. after a pod dies or its event
@@ -417,7 +470,25 @@ def _make_handler(
                 logger.exception("purge_pod failed")
                 self._error(500, f"error: {exc}")
                 return
-            self._reply_json(200, {"pod": pod, "removed": removed})
+            reply = {"pod": pod, "removed": removed}
+            if persistence is not None:
+                # Journal the purge so recovery replays it in order —
+                # without the record, replayed adds resurrect exactly
+                # the entries this endpoint dropped.  The purge already
+                # APPLIED: a journal failure (disk full) must not eat
+                # the reply, but the operator needs to know recovery
+                # would resurrect.
+                try:
+                    persistence.journal.record_purge(pod)
+                    reply["journaled"] = True
+                except Exception:  # noqa: BLE001 — purge applied; reply
+                    logger.exception(
+                        "purge applied but journaling failed: a "
+                        "recovery would resurrect pod %s's entries",
+                        pod,
+                    )
+                    reply["journaled"] = False
+            self._reply_json(200, reply)
 
         def _snapshot(self):
             """Operator trigger: publish an index snapshot now (e.g.
@@ -567,6 +638,8 @@ def serve(
     event_plane_status=None,
     auditor=None,
     tiering=None,
+    replica=None,
+    cluster_status=None,
 ) -> http.server.ThreadingHTTPServer:
     """Start the HTTP service on a background thread; returns the server
     (call ``.shutdown()`` to stop).  ``admin_token`` (env:
@@ -580,7 +653,10 @@ def serve(
     ``GET /debug/cachestats`` and the ``/healthz`` analytics block;
     ``auditor`` (an ``analytics.IndexAuditor``) adds the index-truth
     audit plane to both; ``tiering`` (a ``tiering.PolicyEngine``)
-    backs ``GET /debug/tiering`` and the ``/healthz`` tiering block."""
+    backs ``GET /debug/tiering`` and the ``/healthz`` tiering block;
+    ``replica`` (a ``cluster.ClusterReplica``) serves the
+    ``POST /replica`` RPC surface and ``cluster_status`` (a zero-arg
+    callable) backs ``GET /debug/cluster`` (docs/replication.md)."""
     server = http.server.ThreadingHTTPServer(
         (host, port),
         _make_handler(
@@ -591,6 +667,8 @@ def serve(
             event_plane_status=event_plane_status,
             auditor=auditor,
             tiering=tiering,
+            replica=replica,
+            cluster_status=cluster_status,
         ),
     )
     thread = threading.Thread(
@@ -664,8 +742,137 @@ def main() -> None:  # pragma: no cover - CLI entry
             os.environ.get("READ_PATH_LOOKUP_CHUNK", "32")
         ),
     )
-    indexer = Indexer(config)
+    # CLUSTER_REPLICAS makes this process a cluster ROUTER: the local
+    # backend selection is replaced by a RemoteIndex fanning out to the
+    # configured replicas over HTTP (docs/replication.md).  The rest of
+    # the stack — scoring, kvevents pool, analytics, tiering — works
+    # unchanged against the remote backend.
+    cluster_membership = None
+    cluster_heartbeat = None
+    injected_index = None
+    if os.environ.get("CLUSTER_REPLICAS"):
+        from llm_d_kv_cache_manager_tpu.cluster import (
+            ClusterMembership,
+            HeartbeatMonitor,
+            RemoteIndex,
+        )
+        from llm_d_kv_cache_manager_tpu.cluster.replica import (
+            HttpReplicaTransport,
+        )
+
+        transports = {}
+        for pair in os.environ["CLUSTER_REPLICAS"].split(","):
+            replica_id, _, url = pair.strip().partition("=")
+            if not replica_id or not url:
+                raise ValueError(
+                    "CLUSTER_REPLICAS expects id=url[,id=url...]; got "
+                    f"{pair!r}"
+                )
+            transports[replica_id] = HttpReplicaTransport(
+                url, token=os.environ.get("ADMIN_TOKEN")
+            )
+        cluster_membership = ClusterMembership(transports)
+        cluster_heartbeat = HeartbeatMonitor(
+            cluster_membership,
+            interval_s=float(os.environ.get("CLUSTER_HEARTBEAT_S", "2")),
+            misses=int(os.environ.get("CLUSTER_HEARTBEAT_MISSES", "2")),
+        )
+        cluster_heartbeat.start()
+        injected_index = RemoteIndex(cluster_membership)
+        if config.kvblock_index_config.enable_metrics:
+            from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import (  # noqa: E501 - lazy: mirrors new_index's wrap
+                InstrumentedIndex,
+            )
+
+            injected_index = InstrumentedIndex(injected_index)
+
+    indexer = Indexer(config, kv_block_index=injected_index)
     indexer.run()
+
+    # CLUSTER_SELF makes this process a cluster REPLICA: the local
+    # index (built from the normal backend config above) serves the
+    # POST /replica RPC surface, journals applied ops for replication
+    # (CLUSTER_JOURNAL_DIR), and tails its peers' journals for the
+    # standby slice (CLUSTER_FOLLOW, filtered by CLUSTER_MEMBERS).
+    cluster_replica = None
+    cluster_followers = []
+    if os.environ.get("CLUSTER_SELF"):
+        from llm_d_kv_cache_manager_tpu.cluster import (
+            ClusterReplica,
+            ReplicationFollower,
+            standby_record_filter,
+        )
+        from llm_d_kv_cache_manager_tpu.cluster.ring import HashRing
+        from llm_d_kv_cache_manager_tpu.persistence.journal import Journal
+
+        replica_journal = None
+        if os.environ.get("CLUSTER_JOURNAL_DIR"):
+            replica_journal = Journal(os.environ["CLUSTER_JOURNAL_DIR"])
+        cluster_replica = ClusterReplica(
+            os.environ["CLUSTER_SELF"],
+            index=indexer.kv_block_index,
+            journal=replica_journal,
+            journal_retain_segments=int(
+                os.environ.get("CLUSTER_JOURNAL_RETAIN", "64")
+            ),
+        )
+        record_filter = None
+        members_ring = None
+        members_raw = os.environ.get("CLUSTER_MEMBERS", "")
+        if members_raw:
+            members_ring = HashRing(
+                [m.strip() for m in members_raw.split(",") if m.strip()]
+            )
+            record_filter = standby_record_filter(
+                members_ring, cluster_replica.replica_id
+            )
+        for pair in (os.environ.get("CLUSTER_FOLLOW") or "").split(","):
+            if not pair.strip():
+                continue
+            peer, _, directory = pair.strip().partition("=")
+            if not peer or not directory:
+                raise ValueError(
+                    "CLUSTER_FOLLOW expects peer=journal_dir[,...]; "
+                    f"got {pair!r}"
+                )
+            follower = ReplicationFollower(
+                peer,
+                directory,
+                indexer.kv_block_index,
+                record_filter=record_filter,
+                poll_interval_s=float(
+                    os.environ.get("CLUSTER_FOLLOW_POLL_S", "0.2")
+                ),
+                # Scope the peer's purge replays to its primary slice
+                # (needs the full member ring; unscoped otherwise).
+                purge_scope=(
+                    (
+                        lambda key, peer=peer, ring=members_ring: (
+                            ring.owner(key) == peer
+                        )
+                    )
+                    if members_ring is not None
+                    else None
+                ),
+            )
+            follower.start()
+            cluster_followers.append(follower)
+
+    cluster_status = None
+    if cluster_membership is not None or cluster_replica is not None:
+        def cluster_status() -> dict:
+            status = {
+                "role": "router" if cluster_membership else "replica"
+            }
+            if cluster_membership is not None:
+                status["membership"] = cluster_membership.status()
+            if cluster_replica is not None:
+                status["replica"] = cluster_replica.replica_id
+            if cluster_followers:
+                status["replication"] = [
+                    f.status() for f in cluster_followers
+                ]
+            return status
 
     # TIERING=1 attaches the predictive-tiering policy engine
     # (docs/tiering.md): the scoring stream feeds its PolicyFeed,
@@ -815,6 +1022,8 @@ def main() -> None:  # pragma: no cover - CLI entry
         recovery_report=recovery_report,
         event_plane_status=event_plane_status,
         tiering=policy_engine,
+        replica=cluster_replica,
+        cluster_status=cluster_status,
     )
     try:
         threading.Event().wait()
@@ -839,6 +1048,12 @@ def main() -> None:  # pragma: no cover - CLI entry
             except Exception:  # noqa: BLE001 - best-effort on the way out
                 logger.exception("shutdown snapshot failed")
             persistence.close()
+        if cluster_heartbeat is not None:
+            cluster_heartbeat.close()
+        for follower in cluster_followers:
+            follower.close()
+        if cluster_replica is not None:
+            cluster_replica.close()
         if policy_engine is not None:
             policy_engine.close()
         indexer.shutdown()

@@ -1,0 +1,445 @@
+"""RemoteIndex: the Index contract over a replica fleet.
+
+An :class:`~..kvcache.kvblock.index.Index` implementation that routes
+every operation to the rendezvous owner of its block key and fans
+grouped operations out one RPC per owner — so the whole read/write
+stack above it (fast-lane chunked ``lookup_chain``, the kvevents
+pool's ``add_mappings`` + ``add_entries_batch`` batched apply, the
+analytics ledger, the tiering feed, persistence dumps) works unchanged
+against N replicas.
+
+Routing discipline:
+
+* **Reads** (``lookup`` / ``lookup_chain``): keys group per owner
+  under ONE ring snapshot; one RPC per owner per call — the fast lane
+  already chunks its chain, so a scoring request costs
+  ``ceil(chain/chunk) x owners-touched`` round trips, not one per key.
+* **Writes**: pod-entry admissions live at ``owner(request_key)``;
+  engine->request mappings are published BOTH at
+  ``owner(engine_key)`` (where ``get_request_key`` routes) and at
+  ``owner(request_key)`` (whose local backend resolves them during
+  ``evict``).  An eviction is two hops: resolve the request key at the
+  engine-key owner, evict at the request-key owner.
+* **Failover**: a transport failure marks the replica dead in the
+  membership (ring version bump, failover counter) and the operation
+  retries against the new owner — the rendezvous runner-up, whose
+  replication follower has been keeping that slice warm
+  (``replication.py``).  Application errors propagate; only transport
+  failures fail over.
+
+Not provided: ``version_vector`` / ``touch_chain`` — the indexer's
+exact-prompt score memo detects their absence and disables itself (a
+cross-process memo validator would need a coherence protocol the
+advisory index doesn't warrant).  ``dump_entries`` concatenates every
+alive replica's dump; standby slices may duplicate keys, which
+``restore_entries`` absorbs idempotently.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from llm_d_kv_cache_manager_tpu.cluster.membership import ClusterMembership
+from llm_d_kv_cache_manager_tpu.cluster.replica import (
+    ReplicaUnavailable,
+    decode_entries,
+    encode_entries,
+)
+from llm_d_kv_cache_manager_tpu.cluster.ring import HashRing
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, PodEntry
+from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("cluster.remote_index")
+
+
+class RemoteIndex(Index):
+    """See module docstring."""
+
+    _OWNER_CACHE_MAX = 65536
+
+    def __init__(self, membership: ClusterMembership) -> None:
+        self.membership = membership
+        # key -> (ring, owner), validated by ring IDENTITY on read: a
+        # membership change produces a new immutable ring object, so a
+        # stale entry can never validate (same single-key-dict-op
+        # pattern as InMemoryIndex._group_cache; benign under the GIL).
+        self._owner_cache: Dict[int, Tuple[HashRing, str]] = {}
+
+    # -- routing plumbing ----------------------------------------------
+
+    def _owner(self, ring: HashRing, key: int) -> str:
+        cached = self._owner_cache.get(key)
+        if cached is not None and cached[0] is ring:
+            return cached[1]
+        owner = ring.owner(key)
+        cache = self._owner_cache
+        if len(cache) >= self._OWNER_CACHE_MAX:
+            cache.clear()
+        cache[key] = (ring, owner)
+        return owner
+
+    def _max_attempts(self) -> int:
+        return len(self.membership.members()) + 1
+
+    def _call(self, replica_id: str, method: str, args: list):
+        """One transport call with latency/error accounting; transport
+        failures mark the replica dead (the failover trigger) before
+        re-raising for the caller's re-route loop."""
+        transport = self.membership.transport(replica_id)
+        start = time.perf_counter()
+        try:
+            result = transport.call(method, args)
+        except (ReplicaUnavailable, ConnectionError, OSError) as exc:
+            METRICS.cluster_remote_errors.labels(op=method).inc()
+            self.membership.mark_dead(
+                replica_id, f"{method} failed: {exc}"
+            )
+            raise ReplicaUnavailable(str(exc)) from exc
+        METRICS.cluster_remote_latency.labels(op=method).observe(
+            time.perf_counter() - start
+        )
+        return result
+
+    def _call_routed(self, key: int, method: str, args: list):
+        """Single-key op with failover re-route."""
+        last_exc: Optional[Exception] = None
+        for _ in range(self._max_attempts()):
+            ring = self.membership.ring()
+            owner = self._owner(ring, key)
+            try:
+                return self._call(owner, method, args)
+            except ReplicaUnavailable as exc:
+                last_exc = exc
+                if self.membership.ring() is ring:
+                    # mark_dead refused (last replica alive): re-routing
+                    # would loop on the same owner forever.
+                    break
+        assert last_exc is not None
+        raise last_exc
+
+    def _group_by_owner(
+        self, ring: HashRing, keys: Sequence[int]
+    ) -> Dict[str, List[int]]:
+        groups: Dict[str, List[int]] = {}
+        for key in keys:
+            groups.setdefault(self._owner(ring, key), []).append(key)
+        return groups
+
+    def _fanout(self, pending: list, plan, on_result=None) -> None:
+        """THE failover fan-out loop, shared by every grouped op.
+
+        ``plan(ring, pending)`` returns ``[(owner, method, args,
+        items)]`` — one RPC per owner, ``items`` being the subset of
+        ``pending`` that re-enters the retry set if that owner's
+        transport fails (the failed owner was marked dead by
+        ``_call``, so the re-plan runs on the NEW ring and routes to
+        the failover owner).  The loop stops when everything landed,
+        when the ring identity did not change after a failure (the
+        last-replica refusal — re-planning would loop on the same
+        owner forever), or when attempts exhaust; undeliverable items
+        re-raise the last transport error.  An item that rode more
+        than one failed owner's call retries once (value-dedup for
+        hashable items, identity for the rest).
+        """
+        last_exc: Optional[Exception] = None
+        for _ in range(self._max_attempts()):
+            if not pending:
+                return
+            ring = self.membership.ring()
+            failed: list = []
+            for owner, method, args, items in plan(ring, pending):
+                try:
+                    result = self._call(owner, method, args)
+                except ReplicaUnavailable as exc:
+                    last_exc = exc
+                    failed.extend(items)
+                    continue
+                if on_result is not None:
+                    on_result(result)
+            if not failed:
+                return
+            if self.membership.ring() is ring:
+                break
+            seen = set()
+            pending = []
+            for item in failed:
+                marker = (
+                    item if isinstance(item, (int, tuple)) else id(item)
+                )
+                if marker in seen:
+                    continue
+                seen.add(marker)
+                pending.append(item)
+        if last_exc is not None:
+            raise last_exc
+
+    # -- read path ------------------------------------------------------
+
+    def lookup(
+        self,
+        request_keys: Sequence[int],
+        pod_identifier_set: Optional[Set[str]] = None,
+    ) -> Dict[int, List[PodEntry]]:
+        if not request_keys:
+            raise ValueError("no request keys provided for lookup")
+        pods_arg = sorted(pod_identifier_set) if pod_identifier_set else None
+        result: Dict[int, List[PodEntry]] = {}
+
+        def plan(ring, pending):
+            return [
+                (owner, "lookup", [keys, pods_arg], keys)
+                for owner, keys in self._group_by_owner(
+                    ring, pending
+                ).items()
+            ]
+
+        def on_result(pairs):
+            for key, raw_entries in pairs:
+                result[key] = list(decode_entries(raw_entries))
+
+        self._fanout(list(request_keys), plan, on_result)
+        return result
+
+    def lookup_chain(
+        self, request_keys: Sequence[int]
+    ) -> List[Sequence[PodEntry]]:
+        """Aligned per-key pod snapshots (the fast-lane shape): group
+        the chunk's keys per owner, ONE ``lookup`` RPC per owner, then
+        truncate at the first key with no resident pods.  A replica's
+        own present-but-empty early stop reads as "no pods" for its
+        later keys, which can only move the truncation point EARLIER
+        than or equal to the true break — never report residency past
+        a dead chain (scores stay parity-exact; property-pinned)."""
+        if not request_keys:
+            return []
+        found = self.lookup(request_keys, None)
+        out: List[Sequence[PodEntry]] = []
+        for key in request_keys:
+            pods = found.get(key)
+            if not pods:
+                break
+            out.append(pods)
+        return out
+
+    # -- write path -----------------------------------------------------
+
+    def add(
+        self,
+        engine_keys: Sequence[int],
+        request_keys: Sequence[int],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        if not engine_keys or not request_keys or not entries:
+            raise ValueError("no keys or entries provided for add")
+        if len(engine_keys) != len(request_keys):
+            raise ValueError("engine/request key length mismatch")
+        wire_entries = encode_entries(entries)
+
+        def plan(ring, pending):
+            # Aligned pairs grouped by request-key owner.
+            groups: Dict[str, List[Tuple[int, int]]] = {}
+            for pair in pending:
+                groups.setdefault(
+                    self._owner(ring, pair[1]), []
+                ).append(pair)
+            return [
+                (
+                    owner,
+                    "add",
+                    [
+                        [ek for ek, _ in pairs],
+                        [rk for _, rk in pairs],
+                        wire_entries,
+                    ],
+                    pairs,
+                )
+                for owner, pairs in groups.items()
+            ]
+
+        self._fanout(list(zip(engine_keys, request_keys)), plan)
+        # Mappings published for EVERY pair, not just cross-owner ones:
+        # besides serving get_request_key at the engine-key owner, the
+        # add_mappings RPC journals a mappings-only record whose
+        # standby filter keys on EITHER side — a same-owner pair's
+        # engine-key standby can differ from its request-key standby,
+        # and without the record that standby would miss the mapping
+        # and classify post-failover evictions as "already gone".
+        # Idempotent where it duplicates the full add's mapping.
+        self.add_mappings(engine_keys, request_keys)
+
+    def add_mappings(
+        self, engine_keys: Sequence[int], request_keys: Sequence[int]
+    ) -> None:
+        """Publish engine->request mappings at BOTH owners: the
+        engine-key owner serves ``get_request_key``; the request-key
+        owner's local backend resolves the mapping during ``evict``.
+        A pair that failed on one of its two owners re-routes
+        wholesale (idempotent on the surviving owner)."""
+
+        def plan(ring, pending):
+            groups: Dict[str, List[Tuple[int, int]]] = {}
+            for pair in pending:
+                for owner in {
+                    self._owner(ring, pair[0]),
+                    self._owner(ring, pair[1]),
+                }:
+                    groups.setdefault(owner, []).append(pair)
+            return [
+                (
+                    owner,
+                    "add_mappings",
+                    [
+                        [ek for ek, _ in pairs],
+                        [rk for _, rk in pairs],
+                    ],
+                    pairs,
+                )
+                for owner, pairs in groups.items()
+            ]
+
+        self._fanout(list(zip(engine_keys, request_keys)), plan)
+
+    def add_entries_batch(
+        self,
+        items: Sequence[Tuple[Sequence[int], Sequence[PodEntry]]],
+    ) -> None:
+        """The kvevents batched-apply surface: request keys group per
+        owner across the whole batch — one RPC per owner per flush.
+        An item whose keys straddled a failed owner retries whole on
+        the re-planned ring; its slices that landed re-apply
+        idempotently."""
+        pending = [
+            [list(request_keys), encode_entries(entries)]
+            for request_keys, entries in items
+            if request_keys
+        ]
+
+        def plan(ring, pending):
+            # owner -> ([per-owner wire items], [source items]).
+            groups: Dict[str, Tuple[List[list], List[list]]] = {}
+            for item in pending:
+                request_keys, wire_entries = item
+                by_owner: Dict[str, List[int]] = {}
+                for rk in request_keys:
+                    by_owner.setdefault(
+                        self._owner(ring, rk), []
+                    ).append(rk)
+                for owner, rks in by_owner.items():
+                    bucket = groups.setdefault(owner, ([], []))
+                    bucket[0].append([rks, wire_entries])
+                    bucket[1].append(item)
+            return [
+                (owner, "add_entries_batch", [owner_items], sources)
+                for owner, (owner_items, sources) in groups.items()
+            ]
+
+        self._fanout(pending, plan)
+
+    def evict(self, engine_key: int, entries: Sequence[PodEntry]) -> None:
+        """Two hops: resolve the request key at the engine-key owner,
+        evict at the request-key owner.  When the eviction empties the
+        key (the owner pruned its mapping), the mapping stub at the
+        engine-key owner is evicted too, so ``get_request_key`` raises
+        exactly like a local backend's would."""
+        if not entries:
+            raise ValueError("no entries provided for eviction")
+        try:
+            request_key = self.get_request_key(engine_key)
+        except KeyError:
+            return  # mapping already gone — same no-op as local backends
+        wire_entries = encode_entries(entries)
+        pruned = self._call_routed(
+            request_key, "evict", [engine_key, wire_entries]
+        )
+        if pruned:
+            ring = self.membership.ring()
+            ek_owner = self._owner(ring, engine_key)
+            if ek_owner != self._owner(ring, request_key):
+                try:
+                    self._call(
+                        ek_owner, "evict", [engine_key, wire_entries]
+                    )
+                except ReplicaUnavailable:
+                    # Stub cleanup is best-effort: the dead replica's
+                    # stale mapping lingers exactly like a local LRU
+                    # leftover would.
+                    pass
+
+    def get_request_key(self, engine_key: int) -> int:
+        found, value = self._call_routed(
+            engine_key, "get_request_key", [engine_key]
+        )
+        if not found:
+            raise KeyError(f"engine key not found: {engine_key:#x}")
+        return value
+
+    # -- persistence / admin --------------------------------------------
+
+    def dump_entries(
+        self,
+    ) -> Tuple[List[Tuple[int, List[PodEntry]]], List[Tuple[int, int]]]:
+        """Concatenated dumps of every ALIVE replica.  Standby slices
+        (replication followers warm peers' keys) may duplicate request
+        keys across replicas; restore absorbs duplicates idempotently.
+        An unreachable replica is skipped (and marked dead) — the dump
+        is a best-effort snapshot, the journal covers the gap."""
+        block_entries: List[Tuple[int, List[PodEntry]]] = []
+        engine_map: List[Tuple[int, int]] = []
+        for replica_id in self.membership.alive():
+            try:
+                raw_blocks, raw_map = self._call(
+                    replica_id, "dump_entries", []
+                )
+            except ReplicaUnavailable:
+                continue
+            for key, raw_entries in raw_blocks:
+                block_entries.append(
+                    (key, list(decode_entries(raw_entries)))
+                )
+            engine_map.extend((ek, rk) for ek, rk in raw_map)
+        return block_entries, engine_map
+
+    def restore_entries(
+        self,
+        block_entries: Sequence[Tuple[int, Sequence[PodEntry]]],
+        engine_map: Sequence[Tuple[int, int]],
+    ) -> int:
+        ring = self.membership.ring()
+        blocks_by_owner: Dict[str, List[list]] = {}
+        for request_key, entries in block_entries:
+            blocks_by_owner.setdefault(
+                self._owner(ring, request_key), []
+            ).append([request_key, encode_entries(entries)])
+        maps_by_owner: Dict[str, List[list]] = {}
+        for ek, rk in engine_map:
+            for owner in {self._owner(ring, ek), self._owner(ring, rk)}:
+                maps_by_owner.setdefault(owner, []).append([ek, rk])
+        restored = 0
+        for owner in sorted(set(blocks_by_owner) | set(maps_by_owner)):
+            try:
+                restored += self._call(
+                    owner,
+                    "restore_entries",
+                    [
+                        blocks_by_owner.get(owner, []),
+                        maps_by_owner.get(owner, []),
+                    ],
+                )
+            except ReplicaUnavailable:
+                logger.warning(
+                    "restore skipped unreachable replica %s", owner
+                )
+        return restored
+
+    def purge_pod(self, pod_identifier: str) -> int:
+        removed = 0
+        for replica_id in self.membership.alive():
+            try:
+                removed += self._call(
+                    replica_id, "purge_pod", [pod_identifier]
+                )
+            except ReplicaUnavailable:
+                continue  # dead replica holds no servable entries now
+        return removed

@@ -1,0 +1,461 @@
+"""Replica-side apply surface + the router->replica transports.
+
+A :class:`ClusterReplica` wraps one local ``Index`` backend behind a
+method table — the single dispatch surface shared by every transport:
+the in-process :class:`LocalReplicaTransport` (tests, bench, the
+cluster smoke) and the HTTP endpoint (``POST /replica`` in
+``api/http_service.py``) both land in :meth:`ClusterReplica.handle`.
+
+Wire format (canonical CBOR, the house serialization — lists only, no
+maps): request ``[method, args]``, response ``[status, payload]`` with
+status 0=ok / 1=application error (payload is the message).  Transport
+failures raise :class:`ReplicaUnavailable`; application errors raise
+:class:`ReplicaError` — the router treats only the former as a
+failover trigger.
+
+Journal tap (replication feed): every mutating call is appended to the
+replica's own journal AFTER the local apply succeeds — the same
+applied-ops discipline as the kvevents pool's persistence tap, so a
+follower replays records as exact index calls.  Batched admissions
+arrive without engine keys (the router publishes mappings eagerly via
+``add_mappings``, which is journaled as a mappings-only record), so
+the record stream splits one logical add into a mappings record plus
+an entries record; replay is idempotent and order-preserved within one
+router worker (RPCs from one worker are synchronous).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cbor_canonical import (
+    CborDecodeError,
+    decode_canonical,
+    encode_canonical,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, PodEntry
+from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
+
+logger = get_logger("cluster.replica")
+
+
+class ReplicaError(RuntimeError):
+    """The replica executed the call and reports an application error."""
+
+
+class ReplicaUnavailable(ConnectionError):
+    """The replica could not be reached (transport-level failure)."""
+
+
+# -- wire helpers -------------------------------------------------------
+
+
+def encode_entries(entries: Sequence[PodEntry]) -> List[List[str]]:
+    return [[e.pod_identifier, e.device_tier] for e in entries]
+
+
+def decode_entries(raw) -> Tuple[PodEntry, ...]:
+    return tuple(PodEntry(str(p), str(t)) for p, t in raw)
+
+
+def encode_request(method: str, args: list) -> bytes:
+    return encode_canonical([method, args])
+
+
+def decode_request(data: bytes) -> Tuple[str, list]:
+    doc = decode_canonical(data)
+    if not isinstance(doc, list) or len(doc) != 2:
+        raise CborDecodeError("unexpected replica request shape")
+    method, args = doc
+    if not isinstance(method, str) or not isinstance(args, list):
+        raise CborDecodeError("unexpected replica request shape")
+    return method, args
+
+
+def encode_response(status: int, payload) -> bytes:
+    return encode_canonical([status, payload])
+
+
+def decode_response(data: bytes):
+    doc = decode_canonical(data)
+    if not isinstance(doc, list) or len(doc) != 2:
+        raise CborDecodeError("unexpected replica response shape")
+    status, payload = doc
+    if status:
+        raise ReplicaError(str(payload))
+    return payload
+
+
+class ClusterReplica:
+    """One replica: a local index slice + the RPC method table.
+
+    ``journal`` (a ``persistence.Journal``) enables replication: every
+    applied mutation is appended post-apply, and ``sync_snapshot``
+    serves the follower-bootstrap boundary (rotate + watermarks + dump)
+    — the exact shape ``PersistenceManager.snapshot`` uses, without the
+    file layer.
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        index: Optional[Index] = None,
+        journal=None,
+        journal_retain_segments: int = 64,
+    ) -> None:
+        if not replica_id:
+            raise ValueError("replica_id required")
+        self.replica_id = replica_id
+        self.index = index if index is not None else InMemoryIndex()
+        self.journal = journal
+        # Replication journals have no snapshot boundary to compact
+        # against, so they get size-based retention: the newest N
+        # segments survive (~N x segment_max_bytes on disk), checked
+        # every few hundred appends.  0 disables.  A follower lagging
+        # past the window re-bootstraps (docs/replication.md).
+        self.journal_retain_segments = journal_retain_segments
+        self._journal_appends = 0  # racy-benign tick counter
+        self._methods: Dict[str, Callable] = {
+            "ping": self._ping,
+            "lookup": self._lookup,
+            "lookup_chain": self._lookup_chain,
+            "add": self._add,
+            "add_mappings": self._add_mappings,
+            "add_entries_batch": self._add_entries_batch,
+            "evict": self._evict,
+            "get_request_key": self._get_request_key,
+            "dump_entries": self._dump_entries,
+            "restore_entries": self._restore_entries,
+            "purge_pod": self._purge_pod,
+            "sync_snapshot": self._sync_snapshot,
+        }
+
+    def close(self) -> None:
+        if self.journal is not None:
+            self.journal.close()
+
+    def _journal_tick(self) -> None:
+        """Periodic retention pass after an append (see __init__)."""
+        if self.journal is None or self.journal_retain_segments <= 0:
+            return
+        self._journal_appends += 1
+        if self._journal_appends % 256 == 0:
+            self.journal.compact_keep_last(self.journal_retain_segments)
+
+    # -- dispatch -------------------------------------------------------
+
+    def handle(self, method: str, args: list):
+        """Execute one RPC; raises ``ReplicaError`` for unknown methods
+        (application-level: the replica IS reachable)."""
+        handler = self._methods.get(method)
+        if handler is None:
+            raise ReplicaError(f"unknown replica method: {method!r}")
+        return handler(args)
+
+    def handle_wire(self, data: bytes) -> bytes:
+        """Decode request bytes, execute, encode response bytes — the
+        HTTP endpoint's whole body.  Application errors (including
+        malformed requests) become status-1 responses, never transport
+        failures."""
+        try:
+            method, args = decode_request(data)
+            payload = self.handle(method, args)
+        except Exception as exc:  # noqa: BLE001 — becomes a wire error
+            if not isinstance(exc, ReplicaError):
+                logger.exception(
+                    "replica %s RPC failed", self.replica_id
+                )
+            return encode_response(1, repr(exc))
+        return encode_response(0, payload)
+
+    # -- methods --------------------------------------------------------
+
+    def _ping(self, args):
+        return self.replica_id
+
+    def _lookup(self, args):
+        keys, pods = args
+        pod_set = set(str(p) for p in pods) if pods else None
+        found = self.index.lookup([int(k) for k in keys], pod_set)
+        return [
+            [key, encode_entries(entries)]
+            for key, entries in found.items()
+        ]
+
+    def _lookup_chain(self, args):
+        (keys,) = args
+        chain = self.index.lookup_chain([int(k) for k in keys])
+        return [encode_entries(entries) for entries in chain]
+
+    def _add(self, args):
+        engine_keys, request_keys, raw_entries = args
+        entries = decode_entries(raw_entries)
+        self.index.add(engine_keys, request_keys, entries)
+        if self.journal is not None and entries:
+            self.journal.record_add(
+                entries[0].pod_identifier,
+                0,
+                engine_keys,
+                request_keys,
+                entries,
+            )
+            self._journal_tick()
+        return None
+
+    def _add_mappings(self, args):
+        engine_keys, request_keys = args
+        add_mappings = getattr(self.index, "add_mappings", None)
+        if callable(add_mappings):
+            add_mappings(engine_keys, request_keys)
+        else:
+            raise ReplicaError(
+                "backend lacks add_mappings: "
+                f"{type(self.index).__name__}"
+            )
+        if self.journal is not None:
+            # Mappings-only record (empty entries): replayed via
+            # add_mappings, never as an admission.
+            self.journal.record_add(
+                "", 0, engine_keys, request_keys, []
+            )
+            self._journal_tick()
+        return None
+
+    def _add_entries_batch(self, args):
+        (items,) = args
+        decoded = [
+            (request_keys, decode_entries(raw_entries))
+            for request_keys, raw_entries in items
+        ]
+        add_batch = getattr(self.index, "add_entries_batch", None)
+        if callable(add_batch):
+            add_batch(decoded)
+        else:
+            # Contract fallback (backends without the batched surface):
+            # per-key add with an identity engine mapping.  Evictions
+            # for these keys arrive under the real engine key and miss
+            # (stale entries heal by churn); backends meant for replica
+            # duty implement add_entries_batch.
+            for request_keys, entries in decoded:
+                self.index.add(request_keys, request_keys, entries)
+        if self.journal is not None:
+            for request_keys, entries in decoded:
+                if entries:
+                    self.journal.record_add(
+                        entries[0].pod_identifier,
+                        0,
+                        [],
+                        request_keys,
+                        entries,
+                    )
+                    self._journal_tick()
+        return None
+
+    def _evict(self, args):
+        engine_key, raw_entries = args
+        entries = decode_entries(raw_entries)
+        self.index.evict(int(engine_key), entries)
+        if self.journal is not None and entries:
+            self.journal.record_evict(
+                entries[0].pod_identifier, 0, [int(engine_key)], entries
+            )
+            self._journal_tick()
+        # Pruned flag: did this eviction empty the key (the local
+        # backend then dropped the engine mapping)?  The router uses it
+        # to clean the mapping stub at the engine-key owner, keeping
+        # get_request_key's post-eviction KeyError contract exact
+        # across the cluster.
+        try:
+            self.index.get_request_key(int(engine_key))
+        except KeyError:
+            return 1
+        return 0
+
+    def _get_request_key(self, args):
+        (engine_key,) = args
+        try:
+            return [1, self.index.get_request_key(int(engine_key))]
+        except KeyError:
+            return [0, 0]
+
+    def _dump_entries(self, args):
+        block_entries, engine_map = self.index.dump_entries()
+        return [
+            [
+                [key, encode_entries(entries)]
+                for key, entries in block_entries
+            ],
+            [[ek, rk] for ek, rk in engine_map],
+        ]
+
+    def _restore_entries(self, args):
+        raw_block_entries, engine_map = args
+        block_entries = [
+            (key, decode_entries(raw)) for key, raw in raw_block_entries
+        ]
+        return self.index.restore_entries(
+            block_entries, [(ek, rk) for ek, rk in engine_map]
+        )
+
+    def _purge_pod(self, args):
+        (pod,) = args
+        removed = self.index.purge_pod(str(pod))
+        if self.journal is not None:
+            # Journaled even when removed == 0: a standby slice may
+            # hold entries the primary never did, and replay order must
+            # still drop them.
+            self.journal.record_purge(str(pod))
+            self._journal_tick()
+        return removed
+
+    def _sync_snapshot(self, args):
+        """Follower bootstrap: journal boundary (rotate + per-pod
+        watermarks) then a dump taken AFTER it — every record below the
+        boundary is covered by the dump, so the follower tails from
+        ``TailPosition(boundary, 0)`` and skips numbered records below
+        the watermarks (mirroring recovery's replay rule)."""
+        if self.journal is not None:
+            boundary, watermarks, _ = self.journal.snapshot_boundary()
+        else:
+            boundary, watermarks = 0, {}
+        dump = self._dump_entries([])
+        return [
+            boundary,
+            [[pod, seq] for pod, seq in watermarks.items()],
+            dump[0],
+            dump[1],
+        ]
+
+
+# -- transports ---------------------------------------------------------
+
+
+class LocalReplicaTransport:
+    """In-process transport: calls ``ClusterReplica.handle`` directly.
+
+    ``strict_wire=True`` round-trips every call through the CBOR codec
+    (the contract-parity tests use it so the in-process and HTTP paths
+    cannot drift); the default skips the codec for speed.  ``kill()``
+    makes every subsequent call raise :class:`ReplicaUnavailable` — the
+    failover trigger for tests, the bench, and the smoke.
+    """
+
+    def __init__(
+        self, replica: ClusterReplica, strict_wire: bool = False
+    ) -> None:
+        self.replica = replica
+        self.strict_wire = strict_wire
+        self._killed = threading.Event()
+
+    def kill(self) -> None:
+        self._killed.set()
+
+    def revive(self) -> None:
+        self._killed.clear()
+
+    def call(self, method: str, args: list):
+        if self._killed.is_set():
+            raise ReplicaUnavailable(
+                f"replica {self.replica.replica_id} is down"
+            )
+        if not self.strict_wire:
+            return self.replica.handle(method, args)
+        response = self.replica.handle_wire(
+            encode_request(method, args)
+        )
+        return decode_response(response)
+
+    def close(self) -> None:
+        return None
+
+
+class HttpReplicaTransport:
+    """HTTP transport: ``POST /replica`` with a CBOR body.
+
+    One ``http.client`` connection per calling thread (the router's
+    scoring threads and kvevents workers call concurrently); any
+    transport-level failure closes the connection and raises
+    :class:`ReplicaUnavailable` — retries are the router's decision,
+    not the transport's.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 5.0,
+        token: Optional[str] = None,
+    ) -> None:
+        from urllib.parse import urlsplit
+
+        parsed = urlsplit(base_url)
+        if parsed.scheme not in ("http", ""):
+            raise ValueError(
+                f"unsupported replica URL scheme: {parsed.scheme!r}"
+            )
+        netloc = parsed.netloc or parsed.path
+        host, _, port = netloc.partition(":")
+        self._host = host or "127.0.0.1"
+        self._port = int(port) if port else 8080
+        self._timeout = timeout
+        # The replica endpoint shares the admin gate; cluster
+        # deployments pass ADMIN_TOKEN here (docs/replication.md).
+        self._headers = {"Content-Type": "application/cbor"}
+        if token:
+            self._headers["Authorization"] = f"Bearer {token}"
+        self._local = threading.local()
+
+    def _connection(self):
+        import http.client
+
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._timeout
+            )
+            self._local.conn = conn
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:  # pragma: no cover - best-effort teardown
+                pass
+            self._local.conn = None
+
+    def call(self, method: str, args: list):
+        body = encode_request(method, args)
+        try:
+            conn = self._connection()
+            conn.request(
+                "POST", "/replica", body=body, headers=self._headers
+            )
+            response = conn.getresponse()
+            data = response.read()
+        except (OSError, ConnectionError) as exc:
+            self._drop_connection()
+            raise ReplicaUnavailable(
+                f"replica at {self._host}:{self._port} unreachable: "
+                f"{exc}"
+            ) from exc
+        if response.status != 200:
+            self._drop_connection()
+            raise ReplicaUnavailable(
+                f"replica at {self._host}:{self._port} returned HTTP "
+                f"{response.status}"
+            )
+        try:
+            return decode_response(data)
+        except CborDecodeError as exc:
+            self._drop_connection()
+            raise ReplicaUnavailable(
+                f"garbled replica response: {exc}"
+            ) from exc
+
+    def close(self) -> None:
+        self._drop_connection()

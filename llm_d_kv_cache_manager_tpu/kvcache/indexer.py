@@ -246,13 +246,22 @@ class Indexer:
         chat_processor: Optional[ChatTemplatingProcessor] = None,
         cache_stats_ledger=None,
         policy_engine=None,
+        kv_block_index: Optional[Index] = None,
     ) -> None:
         self.config = config or IndexerConfig()
         self.token_processor = token_processor or ChunkedTokenDatabase(
             self.config.token_processor_config
         )
-        self.kv_block_index: Index = new_index(
-            self.config.kvblock_index_config
+        # An injected backend wins over config — the remote/cluster
+        # unlock (cluster/remote_index.py) and any embedding that
+        # builds its own Index: the whole read path only ever speaks
+        # the lookup/lookup_chain contract, so a remote backend slots
+        # in unchanged (the score memo self-disables when the backend
+        # lacks version_vector/touch_chain, see below).
+        self.kv_block_index: Index = (
+            kv_block_index
+            if kv_block_index is not None
+            else new_index(self.config.kvblock_index_config)
         )
         self.scorer: LongestPrefixScorer = new_scorer(
             self.config.scorer_config

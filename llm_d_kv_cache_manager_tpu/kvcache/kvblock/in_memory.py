@@ -495,6 +495,47 @@ class InMemoryIndex(Index):
             self._bump_shards(touched_shards)
         return restored
 
+    def request_keys(self) -> List[int]:
+        """Resident request keys, concatenated per shard — the
+        keys-only walk (no pod-cache snapshots, no entry lists) backing
+        slice-scoped scans like the replication follower's purge
+        replay.  Point-in-time per shard, like :meth:`dump_entries`."""
+        out: List[int] = []
+        for shard in self._shards:
+            out.extend(shard.keys())
+        return out
+
+    def purge_pod_keys(
+        self, pod_identifier: str, request_keys: Sequence[int]
+    ) -> int:
+        """Purge one pod's entries restricted to ``request_keys``.
+
+        The replication follower's slice-scoped purge
+        (docs/replication.md): replaying a PEER's pod-wide purge record
+        against the whole local index would wipe admissions this
+        replica applied to its OWN slice after the purge — so the
+        follower purges only the keys of the peer's slice.  Keys whose
+        pod set empties are removed exactly like :meth:`purge_pod`'s.
+        """
+        removed = 0
+        touched: Set[int] = set()
+        for request_key in request_keys:
+            shard = self._shard(request_key)
+            pod_cache = shard.get(request_key)
+            if pod_cache is None:
+                continue
+            victims, now_empty = pod_cache.purge(pod_identifier)
+            removed += victims
+            if victims:
+                touched.add(request_key & self._mask)
+            if now_empty:
+                current = shard.get(request_key)
+                if current is not None and len(current) == 0:
+                    shard.remove(request_key)
+        if touched:
+            self._bump_shards(touched)
+        return removed
+
     def purge_pod(self, pod_identifier: str) -> int:
         removed = 0
         for shard in self._shards:

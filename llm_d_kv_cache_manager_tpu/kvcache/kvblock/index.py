@@ -127,9 +127,12 @@ class Index(ABC):
         may land either side of it (the persistence journal covers the
         gap — see ``persistence/``).
 
-        Backends whose store is already durable (Redis/Valkey) return
-        empty lists: their state survives an indexer restart without
-        any snapshot (documented no-op).
+        Durable backends (Redis/Valkey) answer too — a SCAN-walked
+        dump in server iteration order (no recency available) — so
+        replica-duty surfaces (cluster parity, follower bootstrap, the
+        index auditor) see one contract; snapshotting a durable server
+        through the file layer is still usually redundant
+        (docs/persistence.md).
         """
 
     @abstractmethod
@@ -142,9 +145,9 @@ class Index(ABC):
 
         Applies the dump through the backend's normal admission path, so
         capacity/budget bounds hold (an oversized dump is truncated by
-        the same LRU policy as live traffic).  Safe on a non-empty
-        index: restoring an entry that already exists is idempotent.
-        Durable backends (Redis) are a no-op returning 0.
+        the same LRU policy as live traffic; Redis defers to the
+        server's own maxmemory policy).  Safe on a non-empty index:
+        restoring an entry that already exists is idempotent.
         """
 
     @abstractmethod

@@ -25,7 +25,7 @@ import socket
 import ssl
 import threading
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Sequence, Set
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 from urllib.parse import unquote, urlparse
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
@@ -344,6 +344,14 @@ _PRUNE_SCRIPT = (
 
 
 class RedisIndex(Index):
+    # The server outlives the indexer process and is shared by every
+    # replica: startup recovery must never pipeline a possibly-stale
+    # file snapshot back over fresher server state (persistence's
+    # recover() gates on this; docs/persistence.md §6).  Explicit
+    # dump/restore calls (parity tests, follower bootstrap, operator
+    # backups) remain available.
+    durable_backend = True
+
     def __init__(
         self,
         config: Optional[RedisIndexConfig] = None,
@@ -406,6 +414,32 @@ class RedisIndex(Index):
                 result[key] = pods
         return result
 
+    def lookup_chain(
+        self, request_keys: Sequence[int]
+    ) -> List[Sequence[PodEntry]]:
+        """Aligned per-key pod entries for the fast-lane scoring walk:
+        ONE pipelined round trip of HKEYS for the whole chunk (the
+        default adapter would pay the same trip via :meth:`lookup` but
+        build a dict to tear down again), truncated at the first key
+        with no resident pods."""
+        if not request_keys:
+            return []
+        replies = self._client.pipeline(
+            [("HKEYS", str(key)) for key in request_keys]
+        )
+        out: List[Sequence[PodEntry]] = []
+        for fields in replies:
+            pods = []
+            if fields:
+                for field in fields:
+                    entry = self._parse_field(field)
+                    if entry is not None:
+                        pods.append(entry)
+            if not pods:
+                break
+            out.append(pods)
+        return out
+
     def add(
         self,
         engine_keys: Sequence[int],
@@ -426,6 +460,39 @@ class RedisIndex(Index):
                 ("SET", f"{_ENGINE_PREFIX}{engine_key}", str(request_key))
             )
         self._client.pipeline(commands)
+
+    def add_mappings(
+        self, engine_keys: Sequence[int], request_keys: Sequence[int]
+    ) -> None:
+        """Publish engine->request mappings (one pipelined round trip)
+        — the eager half of the kvevents batched-apply surface."""
+        if not engine_keys:
+            return
+        self._client.pipeline(
+            [
+                ("SET", f"{_ENGINE_PREFIX}{ek}", str(rk))
+                for ek, rk in zip(engine_keys, request_keys)
+            ]
+        )
+
+    def add_entries_batch(
+        self,
+        items: Sequence[Tuple[Sequence[int], Sequence[PodEntry]]],
+    ) -> None:
+        """Admit ``(request_keys, entries)`` groups in ONE pipelined
+        round trip (the deferred half of the batched-apply surface;
+        mappings travel separately via :meth:`add_mappings`)."""
+        commands: List[Sequence] = []
+        for request_keys, entries in items:
+            if not entries:
+                continue
+            fields: List = []
+            for entry in entries:
+                fields += [self._field(entry), "1"]
+            for request_key in request_keys:
+                commands.append(["HSET", str(request_key)] + fields)
+        if commands:
+            self._client.pipeline(commands)
 
     def evict(self, engine_key: int, entries: Sequence[PodEntry]) -> None:
         if not entries:
@@ -461,21 +528,107 @@ class RedisIndex(Index):
             raise KeyError(f"engine key not found: {engine_key:#x}")
         return int(raw.decode())
 
-    def dump_entries(self):
-        """Documented no-op: Redis/Valkey IS the durable store.
+    def dump_entries(
+        self,
+    ) -> Tuple[List[Tuple[int, List[PodEntry]]], List[Tuple[int, int]]]:
+        """SCAN-walk the full schema into the standard dump shape.
 
-        The persistence subsystem exists so the in-process backends
-        survive an indexer restart; this backend's state already lives
-        server-side and outlives the process (and is shared by every
-        indexer replica), so snapshotting it through the file layer
-        would only produce a stale second copy that recovery could
-        resurrect over fresher server state.  See docs/persistence.md.
+        This replaced the long-documented no-op when the backend was
+        promoted to replica duty (docs/replication.md): a shared-tier
+        replica must answer the same dump/restore contract as the
+        in-process backends so cluster parity tests, follower
+        bootstrap, and the index-truth auditor see one surface.  The
+        order is server iteration order — Redis tracks no recency, so
+        a capacity-bounded restore into an LRU backend treats the dump
+        as equally-recent (documented divergence from the LRU-first
+        ordering of in-process dumps).  Foreign keys in a shared
+        database (non-numeric names, wrong types) are skipped, never
+        fatal.
+
+        NOTE for persistence: snapshotting a durable server through
+        the file layer yields a second copy that can go stale; prefer
+        pointing recovery at the server itself (restore is idempotent
+        either way — see docs/persistence.md).
         """
-        return [], []
+        block_entries: List[Tuple[int, List[PodEntry]]] = []
+        engine_map: List[Tuple[int, int]] = []
+        cursor = b"0"
+        while True:
+            reply = self._client.execute(
+                "SCAN", cursor.decode(), "COUNT", "512"
+            )
+            cursor, keys = reply[0], reply[1]
+            hash_keys: List[int] = []
+            engine_keys: List[int] = []
+            for key in keys:
+                text = key.decode("utf-8", "replace")
+                if text.startswith(_ENGINE_PREFIX):
+                    try:
+                        engine_keys.append(
+                            int(text[len(_ENGINE_PREFIX):])
+                        )
+                    except ValueError:
+                        continue  # foreign engine:* key
+                else:
+                    try:
+                        hash_keys.append(int(text))
+                    except ValueError:
+                        continue  # foreign key
+            if hash_keys:
+                field_lists = self._client.pipeline(
+                    [("HKEYS", str(key)) for key in hash_keys],
+                    raise_on_error=False,
+                )
+                for key, fields in zip(hash_keys, field_lists):
+                    if isinstance(fields, RespError) or not fields:
+                        continue  # foreign type, or raced a removal
+                    pods = []
+                    for field in fields:
+                        entry = self._parse_field(field)
+                        if entry is not None:
+                            pods.append(entry)
+                    if pods:
+                        block_entries.append((key, pods))
+            if engine_keys:
+                values = self._client.pipeline(
+                    [
+                        ("GET", f"{_ENGINE_PREFIX}{key}")
+                        for key in engine_keys
+                    ],
+                    raise_on_error=False,
+                )
+                for engine_key, raw in zip(engine_keys, values):
+                    if isinstance(raw, RespError) or raw is None:
+                        continue
+                    try:
+                        engine_map.append((engine_key, int(raw)))
+                    except ValueError:
+                        continue  # foreign value
+            if cursor == b"0":
+                return block_entries, engine_map
 
     def restore_entries(self, block_entries, engine_map) -> int:
-        """Documented no-op (see :meth:`dump_entries`); returns 0."""
-        return 0
+        """Pipelined re-admission of a dump (idempotent: HSET/SET of
+        existing state is a no-op server-side); returns block keys
+        carrying entries.  No capacity bound applies — the server's
+        own maxmemory policy governs."""
+        commands: List[Sequence] = []
+        restored = 0
+        for request_key, entries in block_entries:
+            if not entries:
+                continue
+            hset: List = ["HSET", str(request_key)]
+            for entry in entries:
+                hset += [self._field(entry), "1"]
+            commands.append(hset)
+            restored += 1
+        for engine_key, request_key in engine_map:
+            commands.append(
+                ("SET", f"{_ENGINE_PREFIX}{engine_key}", str(request_key))
+            )
+        if commands:
+            self._client.pipeline(commands)
+        return restored
 
     def purge_pod(self, pod_identifier: str) -> int:
         """SCAN-walk the request hashes, HDEL the pod's fields.

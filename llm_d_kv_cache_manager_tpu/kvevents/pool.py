@@ -439,6 +439,7 @@ class _BatchApplier:
         "_adds",
         "_records",
         "_traces",
+        "_mappings",
     )
 
     def __init__(self, index: Index, journal) -> None:
@@ -454,6 +455,14 @@ class _BatchApplier:
         # discard admissions from EARLIER messages in the batch, whose
         # traces would otherwise finish "ok" at batch end.
         self._traces: List[Trace] = []
+        # Engine->request mappings published by THIS batch: parent
+        # resolution consults it before the index, so a parent stored
+        # earlier in the batch resolves without a backend round trip —
+        # for a remote backend (cluster/remote_index.py) that is one
+        # RPC saved per chained event; for local backends it is merely
+        # a dict hit instead of an LRU lock.  Mirrors already-published
+        # state (add_mappings is eager), so semantics are unchanged.
+        self._mappings: Dict[int, int] = {}
 
     def add(
         self,
@@ -464,6 +473,7 @@ class _BatchApplier:
         entries: Sequence[PodEntry],
         owner_trace: Optional[Trace] = None,
     ) -> None:
+        self._mappings.update(zip(engine_keys, request_keys))
         if not self._batched:
             self._index.add(engine_keys, request_keys, entries)
             if self._journal is not None:
@@ -479,6 +489,15 @@ class _BatchApplier:
             self._records.append(
                 (pod_identifier, seq, engine_keys, request_keys, entries)
             )
+
+    def resolve_request_key(self, engine_key: int) -> int:
+        """Parent resolution for chained events: the batch's own
+        published mappings first, the index second.  Raises KeyError
+        like ``Index.get_request_key``."""
+        request_key = self._mappings.get(engine_key)
+        if request_key is not None:
+            return request_key
+        return self._index.get_request_key(engine_key)
 
     def flush(self) -> None:
         """Apply deferred admissions (grouped per shard), then journal
@@ -770,6 +789,13 @@ class Pool:
             with use_trace(tr):
                 with obs_span("kvevents.resync.apply") as s:
                     purged = self._index.purge_pod(job.pod_identifier)
+                    if self._journal is not None:
+                        # The purge must replay before the re-applied
+                        # inventory (recovery + replication followers
+                        # replay in journal order), or a crash between
+                        # here and the next snapshot resurrects the
+                        # purged claims.
+                        self._journal.record_purge(job.pod_identifier)
                     applier = _BatchApplier(self._index, self._journal)
                     applied = 0
                     for event in job.events:
@@ -872,7 +898,7 @@ class Pool:
                 parent_engine_key = engine_hash_to_uint64(
                     event.parent_block_hash
                 )
-                parent_request_key = self._index.get_request_key(
+                parent_request_key = applier.resolve_request_key(
                     parent_engine_key
                 )
             except (TypeError, ValueError, KeyError) as exc:

@@ -304,6 +304,58 @@ class KVCacheMetrics:
             "Age of the policy feed's current prediction snapshot.",
             registry=self.registry,
         )
+        # Replicated index service (cluster/; docs/replication.md).
+        self.cluster_ring_version = Gauge(
+            f"{_NAMESPACE}_cluster_ring_version",
+            "Version of the router's consistent-hash ring (bumps on "
+            "every membership change).",
+            registry=self.registry,
+        )
+        self.cluster_replicas_alive = Gauge(
+            f"{_NAMESPACE}_cluster_replicas_alive",
+            "Replicas currently considered alive by the router's "
+            "membership (heartbeat-healthy).",
+            registry=self.registry,
+        )
+        self.cluster_failovers = Counter(
+            f"{_NAMESPACE}_cluster_failovers_total",
+            "Replicas removed from the ring (heartbeat timeout or "
+            "observed transport failure); each removal re-routes the "
+            "replica's slice to its rendezvous runner-up.",
+            registry=self.registry,
+        )
+        self.cluster_remote_latency = Histogram(
+            f"{_NAMESPACE}_cluster_remote_latency_seconds",
+            "Latency of router->replica RPCs by operation.",
+            ("op",),
+            registry=self.registry,
+            buckets=(
+                0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+            ),
+        )
+        self.cluster_remote_errors = Counter(
+            f"{_NAMESPACE}_cluster_remote_errors_total",
+            "Router->replica RPCs that failed at the transport layer, "
+            "by operation (each marks the replica dead and retries on "
+            "the failover owner).",
+            ("op",),
+            registry=self.registry,
+        )
+        self.cluster_replica_lag = Gauge(
+            f"{_NAMESPACE}_cluster_replica_lag_records",
+            "Journal records a replication follower was behind its "
+            "primary when its last sync poll began, by followed peer.",
+            ("peer",),
+            registry=self.registry,
+        )
+        self.cluster_replication_applied = Counter(
+            f"{_NAMESPACE}_cluster_replication_applied_total",
+            "Journal records applied by replication followers, by "
+            "followed peer.",
+            ("peer",),
+            registry=self.registry,
+        )
         # Per-stage latencies fed by the tracing subsystem (obs/trace.py):
         # every span of a sampled trace lands here under its span name, so
         # the aggregate view and the per-request flight-recorder view

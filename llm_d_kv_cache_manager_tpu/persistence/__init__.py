@@ -23,6 +23,8 @@ from llm_d_kv_cache_manager_tpu.persistence.journal import (  # noqa: F401
     JournalRecord,
     OP_ADD,
     OP_EVICT,
+    TailPosition,
+    tail,
 )
 from llm_d_kv_cache_manager_tpu.persistence.recovery import (  # noqa: F401
     PersistenceConfig,

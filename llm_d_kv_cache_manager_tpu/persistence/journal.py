@@ -18,7 +18,10 @@ Segment files ``segment-<id>.kvj`` (see docs/persistence.md):
     [op, pod, seq, ts_ns, engine_keys, request_keys,
      [[pod, tier], ...]]
 
-with ``op`` 0=add, 1=evict (evict carries an empty request_keys list).
+with ``op`` 0=add, 1=evict (evict carries an empty request_keys list),
+2=purge (an administrative ``purge_pod``; keys and entries empty, the
+purged pod in the ``pod`` field — replay must not resurrect what an
+operator dropped).
 A reader stops at the first record that is short, oversized, or fails
 CRC — the torn-tail contract: a crash mid-append loses at most the
 record being written, never the ability to replay what preceded it.
@@ -69,6 +72,11 @@ SEGMENT_SUFFIX = ".kvj"
 
 OP_ADD = 0
 OP_EVICT = 1
+# Administrative pod purge (Index.purge_pod): engine/request keys and
+# entries are empty; ``pod_identifier`` names the purged pod.  Without
+# this record a replay (recovery, replication followers) would
+# resurrect entries an operator explicitly dropped.
+OP_PURGE = 2
 
 # A single record is a few KB at most (one BlockStored batch); anything
 # bigger is framing corruption, treated like a torn tail.
@@ -259,6 +267,19 @@ class Journal:
             )
         )
 
+    def record_purge(self, pod_identifier: str, seq: int = 0) -> None:
+        self._append(
+            JournalRecord(
+                op=OP_PURGE,
+                pod_identifier=pod_identifier,
+                seq=int(seq),
+                ts_ns=time.time_ns(),
+                engine_keys=[],
+                request_keys=[],
+                entries=[],
+            )
+        )
+
     def _append(self, record: JournalRecord) -> None:
         body = record.encode()
         framed = (
@@ -281,7 +302,9 @@ class Journal:
             if self._segment_bytes >= self.segment_max_bytes:
                 self._rotate_locked()
         METRICS.persistence_journal_records.labels(
-            op="add" if record.op == OP_ADD else "evict"
+            op={OP_ADD: "add", OP_EVICT: "evict"}.get(
+                record.op, "purge"
+            )
         ).inc()
         METRICS.persistence_journal_lag.set(lag)
 
@@ -339,6 +362,31 @@ class Journal:
             lag = self._records_since_snapshot
         METRICS.persistence_journal_lag.set(lag)
 
+    def compact_keep_last(self, retain_segments: int) -> int:
+        """Delete all but the newest ``retain_segments`` segment files;
+        returns segments removed.  Size-based retention for journals
+        with no snapshot boundary to compact against (cluster replicas'
+        replication feeds — docs/replication.md): a follower lagging
+        past the retention window loses the deleted records (the tail
+        cursor skips the hole) and should re-bootstrap.  The active
+        segment is always within the retained suffix."""
+        if retain_segments <= 0:
+            return 0
+        removed = 0
+        for _, path in list_segments(self.directory)[:-retain_segments]:
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:  # pragma: no cover - concurrent compactor
+                pass
+        if removed:
+            logger.info(
+                "retention-compacted %d journal segment(s) in %s",
+                removed,
+                self.directory,
+            )
+        return removed
+
     def compact_before(self, boundary_id: int) -> int:
         """Delete sealed segments with id < boundary_id; returns count."""
         removed = 0
@@ -375,7 +423,175 @@ class Journal:
                 self._handle = None
 
 
-def iter_journal(directory: str) -> Iterator[JournalRecord]:
-    """Replay every surviving record, oldest segment first."""
-    for _, path in list_segments(directory):
+def iter_journal(
+    directory: str, min_segment_id: int = 0
+) -> Iterator[JournalRecord]:
+    """Replay every surviving record, oldest segment first.  Segments
+    below ``min_segment_id`` (a snapshot's journal boundary — fully
+    covered by the dump) are skipped wholesale."""
+    for segment_id, path in list_segments(directory):
+        if segment_id < min_segment_id:
+            continue
         yield from read_segment(path)
+
+
+# -- follow API (replication followers; docs/replication.md) ------------
+
+
+@dataclass(frozen=True)
+class TailPosition:
+    """A resumable cursor into a journal directory.
+
+    ``offset == 0`` means the segment's file header has not been
+    validated yet; otherwise it is the byte offset just past the last
+    fully-consumed record.  Positions are plain data — safe to persist
+    or ship between processes.  ``TailPosition(boundary_id, 0)`` starts
+    a follow at a snapshot boundary (every record in segments
+    ``< boundary_id`` is covered by the snapshot; see
+    ``Journal.snapshot_boundary``).
+    """
+
+    segment_id: int
+    offset: int = 0
+
+
+def tail(
+    directory: str,
+    position: Optional[TailPosition] = None,
+    max_records: int = 0,
+) -> Tuple[List[JournalRecord], TailPosition]:
+    """Read records appended since ``position``; returns
+    ``(records, new_position)``.
+
+    The follow contract (regression-pinned in
+    tests/test_journal_tail.py):
+
+    * **Torn tails hold, they don't lose.**  A partial record at the
+      end of the ACTIVE (highest-id) segment — the writer's append may
+      be partially visible — leaves the cursor at the last complete
+      record; the next call re-reads from there and returns the record
+      once it is whole.  In a SEALED segment (a higher-id segment
+      exists) a torn or corrupt record can never complete: the rest of
+      that segment is abandoned (same stop-don't-skip policy as
+      ``read_segment``, logged) and the cursor moves to the next
+      segment.
+    * **Rotation is seamless.**  Clean EOF on a sealed segment advances
+      to the next segment id present on disk; gaps in the id sequence
+      (compaction, or a sealed segment deleted mid-follow) are skipped
+      to the smallest surviving id.
+    * **Decode-bad records skip.**  A CRC-valid record that fails CBOR
+      decoding is fully written and will never change; holding would
+      wedge the follower forever, so it is skipped with a warning.
+
+    ``position=None`` starts at the oldest segment on disk.
+    ``max_records`` bounds one call (0 = unbounded); a bounded call may
+    return mid-segment and resumes exactly where it stopped.
+    """
+    segments = list_segments(directory)
+    if position is None:
+        start_id = segments[0][0] if segments else 0
+        position = TailPosition(start_id, 0)
+    if not segments:
+        return [], position
+
+    records: List[JournalRecord] = []
+    segment_id = position.segment_id
+    offset = position.offset
+    latest_id = segments[-1][0]
+    by_id = dict(segments)
+    while True:
+        if max_records and len(records) >= max_records:
+            break
+        path = by_id.get(segment_id)
+        if path is None:
+            successors = [sid for sid in by_id if sid > segment_id]
+            if not successors:
+                break  # nothing (yet) at or past the cursor
+            segment_id = min(successors)
+            offset = 0
+            continue
+        sealed = segment_id < latest_id
+        consumed, segment_records, exhausted = _read_from(
+            path,
+            offset,
+            max_records - len(records) if max_records else 0,
+        )
+        records.extend(segment_records)
+        offset = consumed
+        if not exhausted:
+            break  # record budget reached mid-segment
+        if not sealed:
+            break  # active segment: hold at the last complete record
+        # Sealed: whatever stopped us (clean EOF, torn tail, corrupt
+        # record) can never change — move on.
+        segment_id += 1
+        offset = 0
+    return records, TailPosition(segment_id, offset)
+
+
+def _read_from(
+    path: str, offset: int, max_records: int
+) -> Tuple[int, List[JournalRecord], bool]:
+    """Read complete records from ``offset``; returns
+    ``(new_offset, records, exhausted)`` where ``exhausted`` means the
+    stop was the segment itself (EOF/torn/corrupt), not the record
+    budget.  ``new_offset`` never advances past a record that failed to
+    read completely."""
+    records: List[JournalRecord] = []
+    try:
+        handle = open(path, "rb")
+    except FileNotFoundError:  # compacted between listing and open
+        return offset, records, True
+    with handle:
+        if offset == 0:
+            header = handle.read(_FILE_HEADER.size)
+            if len(header) < _FILE_HEADER.size:
+                return 0, records, True  # header not fully visible yet
+            magic, version = _FILE_HEADER.unpack(header)
+            if magic != MAGIC or version != FORMAT_VERSION:
+                logger.warning(
+                    "foreign journal segment %s in follow; skipping", path
+                )
+                # Report exhausted with the cursor parked at EOF-ish;
+                # a sealed foreign file is skipped by the caller, an
+                # active one holds (and is re-checked, staying cheap).
+                return 0, records, True
+            offset = _FILE_HEADER.size
+        else:
+            handle.seek(offset)
+        while True:
+            if max_records and len(records) >= max_records:
+                return offset, records, False
+            rec_header = handle.read(_RECORD_HEADER.size)
+            if len(rec_header) < _RECORD_HEADER.size:
+                return offset, records, True  # clean EOF or torn header
+            length, crc = _RECORD_HEADER.unpack(rec_header)
+            if length > MAX_RECORD_BYTES:
+                logger.warning(
+                    "implausible record length %d in %s at %d; stopping",
+                    length,
+                    path,
+                    offset,
+                )
+                return offset, records, True
+            body = handle.read(length)
+            if len(body) < length:
+                return offset, records, True  # torn body
+            if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                logger.warning(
+                    "CRC mismatch in %s at %d; stopping", path, offset
+                )
+                return offset, records, True
+            consumed = offset + _RECORD_HEADER.size + length
+            try:
+                records.append(JournalRecord.decode(body))
+            except (CborDecodeError, TypeError, ValueError) as exc:
+                # Fully written (CRC passed) — will never change;
+                # holding would wedge the follower forever.
+                logger.warning(
+                    "undecodable record in %s at %d (%s); skipping",
+                    path,
+                    offset,
+                    exc,
+                )
+            offset = consumed

@@ -39,6 +39,7 @@ from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
 from llm_d_kv_cache_manager_tpu.persistence.journal import (
     DEFAULT_SEGMENT_MAX_BYTES,
     OP_ADD,
+    OP_PURGE,
     Journal,
     iter_journal,
 )
@@ -136,10 +137,36 @@ def recover(index: Index, config: PersistenceConfig) -> RecoveryReport:
     report = RecoveryReport(status="cold")
     pods: Dict[str, None] = {}  # ordered de-dup
 
+    if getattr(index, "durable_backend", False):
+        # The backend's state outlives the process (Redis/Valkey) and
+        # is shared by sibling replicas: restoring a file snapshot or
+        # replaying the journal would resurrect entries evicted or
+        # purged server-side since the dump — the server IS the warm
+        # state.  (The old dump_entries no-op used to make this
+        # implicit; the gate became explicit when the backend was
+        # promoted to the full dump/restore contract.)
+        report.duration_s = time.perf_counter() - start
+        METRICS.persistence_recoveries.labels(outcome="durable").inc()
+        logger.info(
+            "recovery skipped: %s is a durable backend (server state "
+            "is authoritative; docs/persistence.md §6)",
+            type(index).__name__,
+        )
+        return report
+
     watermarks: Dict[str, int] = {}
+    min_segment_id = 0
     loaded = load_latest_snapshot(config.snapshot_dir)
     if loaded is not None:
         info, block_entries, engine_map = loaded
+        if info.journal_boundary is not None:
+            # Segments below the boundary are fully covered by the
+            # dump: skipping them wholesale is both cheaper and
+            # CORRECT where per-record idempotence is not — an
+            # uncompacted pre-boundary OP_PURGE would otherwise replay
+            # against restored state whose covering re-adds the
+            # watermark skip elides.
+            min_segment_id = info.journal_boundary
         report.block_keys_restored = index.restore_entries(
             block_entries, engine_map
         )
@@ -152,7 +179,9 @@ def recover(index: Index, config: PersistenceConfig) -> RecoveryReport:
             for entry in entries:
                 pods.setdefault(entry.pod_identifier, None)
 
-    for record in iter_journal(config.journal_dir):
+    for record in iter_journal(
+        config.journal_dir, min_segment_id=min_segment_id
+    ):
         watermark = watermarks.get(record.pod_identifier)
         # Strictly below only: one message's events share one seq, and
         # a record with seq == watermark can have been appended AFTER
@@ -175,6 +204,11 @@ def recover(index: Index, config: PersistenceConfig) -> RecoveryReport:
                         record.request_keys,
                         record.entries,
                     )
+            elif record.op == OP_PURGE:
+                # An operator/resync purge between the snapshot and the
+                # crash: replaying the adds without it would resurrect
+                # exactly the entries the purge dropped.
+                index.purge_pod(record.pod_identifier)
             else:
                 for engine_key in record.engine_keys:
                     index.evict(engine_key, record.entries)
@@ -255,6 +289,7 @@ class PersistenceManager:
                 block_entries,
                 engine_map,
                 retain=self.config.snapshots_retained,
+                journal_boundary=boundary,
             )
             self.journal.compact_before(boundary)
             self.journal.mark_snapshot_published(covered)

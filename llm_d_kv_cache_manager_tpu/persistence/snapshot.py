@@ -69,6 +69,11 @@ class SnapshotInfo:
     block_keys: int
     engine_mappings: int
     watermarks: Dict[str, int]
+    # Journal boundary at the dump (Journal.snapshot_boundary): every
+    # record in segments < this id is covered by the snapshot.  None
+    # for snapshots written before the field existed (or without a
+    # journal); recovery then falls back to replay-everything.
+    journal_boundary: Optional[int] = None
 
 
 def _encode_body(
@@ -76,21 +81,28 @@ def _encode_body(
     watermarks: Dict[str, int],
     block_entries: Sequence[Tuple[int, Sequence[PodEntry]]],
     engine_map: Sequence[Tuple[int, int]],
+    journal_boundary: Optional[int],
 ) -> bytes:
-    return encode_canonical(
+    doc = [
+        created_ns,
+        [[pod, int(seq)] for pod, seq in sorted(watermarks.items())],
         [
-            created_ns,
-            [[pod, int(seq)] for pod, seq in sorted(watermarks.items())],
             [
-                [
-                    int(request_key),
-                    [[e.pod_identifier, e.device_tier] for e in pods],
-                ]
-                for request_key, pods in block_entries
-            ],
-            [[int(ek), int(rk)] for ek, rk in engine_map],
-        ]
-    )
+                int(request_key),
+                [[e.pod_identifier, e.device_tier] for e in pods],
+            ]
+            for request_key, pods in block_entries
+        ],
+        [[int(ek), int(rk)] for ek, rk in engine_map],
+    ]
+    if journal_boundary is not None:
+        # Optional 5th element (decoder accepts 4 or 5): segments below
+        # this journal id are fully covered by the snapshot, so
+        # recovery skips them wholesale — without it, an uncompacted
+        # pre-boundary OP_PURGE could replay against restored state
+        # whose covering re-adds the watermark skip elides.
+        doc.append(int(journal_boundary))
+    return encode_canonical(doc)
 
 
 def write_snapshot(
@@ -99,6 +111,7 @@ def write_snapshot(
     block_entries: Sequence[Tuple[int, Sequence[PodEntry]]],
     engine_map: Sequence[Tuple[int, int]],
     retain: int = 2,
+    journal_boundary: Optional[int] = None,
 ) -> SnapshotInfo:
     """Publish a snapshot atomically; prunes to the ``retain`` newest.
 
@@ -111,7 +124,10 @@ def write_snapshot(
     """
     os.makedirs(directory, exist_ok=True)
     created_ns = time.time_ns()
-    body = _encode_body(created_ns, watermarks, block_entries, engine_map)
+    body = _encode_body(
+        created_ns, watermarks, block_entries, engine_map,
+        journal_boundary,
+    )
     header = _HEADER.pack(
         MAGIC, FORMAT_VERSION, zlib.crc32(body) & 0xFFFFFFFF, len(body)
     )
@@ -139,6 +155,7 @@ def write_snapshot(
         block_keys=len(block_entries),
         engine_mappings=len(engine_map),
         watermarks=dict(watermarks),
+        journal_boundary=journal_boundary,
     )
 
 
@@ -229,9 +246,10 @@ def read_snapshot(
         doc = decode_canonical(body)
     except CborDecodeError as exc:
         raise SnapshotError(f"{path}: undecodable body: {exc}") from exc
-    if not isinstance(doc, list) or len(doc) != 4:
+    if not isinstance(doc, list) or len(doc) not in (4, 5):
         raise SnapshotError(f"{path}: unexpected document shape")
-    created_ns, raw_watermarks, raw_entries, raw_engine_map = doc
+    created_ns, raw_watermarks, raw_entries, raw_engine_map = doc[:4]
+    raw_boundary = doc[4] if len(doc) == 5 else None
     try:
         watermarks = {
             str(pod): int(seq) for pod, seq in raw_watermarks
@@ -244,6 +262,9 @@ def read_snapshot(
             for request_key, pods in raw_entries
         ]
         engine_map = [(int(ek), int(rk)) for ek, rk in raw_engine_map]
+        journal_boundary = (
+            int(raw_boundary) if raw_boundary is not None else None
+        )
     except (TypeError, ValueError) as exc:
         raise SnapshotError(f"{path}: type-confused body: {exc}") from exc
     info = SnapshotInfo(
@@ -253,6 +274,7 @@ def read_snapshot(
         block_keys=len(block_entries),
         engine_mappings=len(engine_map),
         watermarks=watermarks,
+        journal_boundary=journal_boundary,
     )
     return info, block_entries, engine_map
 

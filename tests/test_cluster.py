@@ -1,0 +1,752 @@
+"""cluster/: ring properties, failover, replication, routing, parity.
+
+The acceptance pins (ISSUE 10 / docs/replication.md):
+
+* the rendezvous ring is deterministic ACROSS PROCESSES (never
+  ``hash()``-seeded) and membership changes move ~1/N of the keys —
+  each straight to its runner-up, never a full reshuffle;
+* a 3-replica in-process cluster returns BIT-IDENTICAL scores to the
+  single-process ``InMemoryIndex`` on a randomized workload (the same
+  style as the fast-lane parity oracle);
+* a killed replica's slice fails over to its journal-fed follower
+  warm, and purges are never resurrected by replay;
+* the kvevents pool routes admissions to slice owners through the
+  unchanged batched-apply surface.
+"""
+
+import random
+import subprocess
+import sys
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.cluster import (
+    ClusterMembership,
+    HeartbeatMonitor,
+    LocalCluster,
+    RemoteIndex,
+)
+from llm_d_kv_cache_manager_tpu.cluster.replica import (
+    ClusterReplica,
+    HttpReplicaTransport,
+    LocalReplicaTransport,
+    ReplicaError,
+    ReplicaUnavailable,
+)
+from llm_d_kv_cache_manager_tpu.cluster.replication import (
+    ReplicationFollower,
+    standby_record_filter,
+)
+from llm_d_kv_cache_manager_tpu.cluster.ring import HashRing
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+    Indexer,
+    IndexerConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    IndexConfig,
+    InMemoryIndexConfig,
+    PodEntry,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    EMPTY_BLOCK_HASH,
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    Message,
+    Pool,
+    PoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.persistence.journal import Journal
+from tests.test_read_path_fastlane import WordTokenizer, words
+
+MODEL = "m"
+POD_A = PodEntry("pod-a", "hbm")
+POD_B = PodEntry("pod-b", "host")
+
+KEYS = [((i * 2654435761) ^ (i << 17)) & ((1 << 64) - 1) for i in range(2000)]
+
+
+# ------------------------------------------------------------- ring
+
+
+class TestHashRing:
+    def test_deterministic_across_processes_and_seeds(self):
+        """Ownership must never depend on PYTHONHASHSEED: a router and
+        a replica booted with different seeds MUST agree on every
+        key's owner (the subprocess recomputes with a different
+        seed)."""
+        members = ["replica-0", "replica-1", "replica-2"]
+        ring = HashRing(members)
+        keys = KEYS[:64]
+        expected = [ring.owner(k) for k in keys]
+        script = (
+            "from llm_d_kv_cache_manager_tpu.cluster.ring import "
+            "HashRing;"
+            f"ring = HashRing({members!r});"
+            f"print(','.join(ring.owner(k) for k in {keys!r}))"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            env={
+                "PYTHONHASHSEED": "12345",
+                "PATH": "/usr/bin:/bin",
+                "PYTHONPATH": ":".join(sys.path),
+                "JAX_PLATFORMS": "cpu",
+            },
+            check=True,
+        )
+        assert out.stdout.strip().split(",") == expected
+
+    def test_membership_order_is_irrelevant(self):
+        a = HashRing(["r2", "r0", "r1"])
+        b = HashRing(["r0", "r1", "r2"])
+        assert [a.owner(k) for k in KEYS[:200]] == [
+            b.owner(k) for k in KEYS[:200]
+        ]
+
+    @pytest.mark.parametrize("n", [3, 5, 8])
+    def test_remove_moves_about_one_over_n(self, n):
+        """Removing one member reassigns ONLY its keys (~1/N of the
+        space), each to its rendezvous runner-up — never a reshuffle
+        of keys the dead member did not own."""
+        members = [f"replica-{i}" for i in range(n)]
+        ring = HashRing(members)
+        owners = {k: ring.owner(k) for k in KEYS}
+        victim = members[0]
+        shrunk = ring.without(victim)
+        moved = 0
+        for k, owner in owners.items():
+            new_owner = shrunk.owner(k)
+            if owner != victim:
+                assert new_owner == owner  # untouched slice
+            else:
+                moved += 1
+                # Straight to the runner-up.
+                assert new_owner == ring.owners(k, 2)[1]
+        fraction = moved / len(KEYS)
+        assert 0.5 / n < fraction < 2.0 / n
+
+    @pytest.mark.parametrize("n", [3, 5])
+    def test_add_steals_about_one_over_n_plus_one(self, n):
+        members = [f"replica-{i}" for i in range(n)]
+        ring = HashRing(members)
+        grown = ring.with_member("replica-new")
+        moved = sum(
+            1 for k in KEYS if grown.owner(k) != ring.owner(k)
+        )
+        for k in KEYS[:500]:
+            if grown.owner(k) != ring.owner(k):
+                assert grown.owner(k) == "replica-new"
+        fraction = moved / len(KEYS)
+        assert 0.5 / (n + 1) < fraction < 2.0 / (n + 1)
+
+    def test_distribution_roughly_uniform(self):
+        ring = HashRing(["r0", "r1", "r2", "r3"])
+        counts = {}
+        for k in KEYS:
+            counts[ring.owner(k)] = counts.get(ring.owner(k), 0) + 1
+        for member, count in counts.items():
+            assert 0.6 * len(KEYS) / 4 < count < 1.4 * len(KEYS) / 4
+
+    def test_versioning_and_immutability(self):
+        ring = HashRing(["r0", "r1"], version=3)
+        assert ring.version == 3
+        shrunk = ring.without("r0")
+        assert shrunk.version == 4 and ring.version == 3
+        assert ring.without("missing") is ring
+        assert ring.with_member("r1") is ring
+        grown = ring.with_member("r2")
+        assert grown.version == 4 and "r2" in grown
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            HashRing([])
+        with pytest.raises(ValueError):
+            HashRing([""])
+
+
+# ------------------------------------------------------- failover
+
+
+class TestFailover:
+    def test_killed_replica_slice_fails_over_warm(self, tmp_path):
+        cluster = LocalCluster(journal_root=str(tmp_path))
+        try:
+            idx = cluster.remote_index
+            keys = KEYS[:300]
+            idx.add(keys, keys, [POD_A, POD_B])
+            assert cluster.sync_followers() > 0
+
+            ring = cluster.membership.ring()
+            victim = ring.owner(keys[0])
+            owned = [k for k in keys if ring.owner(k) == victim]
+            assert owned  # the victim owns a real slice
+            before = idx.lookup(owned)
+
+            cluster.kill(victim)
+            after = idx.lookup(owned)
+            # Warm failover: the runner-up serves the whole slice.
+            assert set(after) == set(before)
+            for k in owned:
+                assert set(after[k]) == set(before[k])
+            assert cluster.membership.failover_count() == 1
+            assert (
+                cluster.membership.ring().version
+                == ring.version + 1
+            )
+        finally:
+            cluster.close()
+
+    def test_transport_failure_mid_call_triggers_failover(self, tmp_path):
+        """No explicit notice: the first routed call that hits the dead
+        replica marks it dead and retries on the new owner."""
+        cluster = LocalCluster(journal_root=str(tmp_path))
+        try:
+            idx = cluster.remote_index
+            keys = KEYS[300:400]
+            idx.add(keys, keys, [POD_A])
+            cluster.sync_followers()
+            victim = cluster.membership.ring().owner(keys[0])
+            owned = [
+                k
+                for k in keys
+                if cluster.membership.ring().owner(k) == victim
+            ]
+            cluster.kill(victim, notice=False)
+            found = idx.lookup(owned)  # discovers the death inline
+            assert set(found) == set(owned)
+            assert not cluster.membership.is_alive(victim)
+        finally:
+            cluster.close()
+
+    def test_purge_is_not_resurrected_by_replay(self, tmp_path):
+        """purge_pod is journaled (OP_PURGE) and replays in order: a
+        follower syncing AFTER the purge must not resurrect the
+        purged pod's entries from the earlier add records."""
+        cluster = LocalCluster(journal_root=str(tmp_path))
+        try:
+            idx = cluster.remote_index
+            keys = KEYS[400:500]
+            idx.add(keys, keys, [POD_A, POD_B])
+            assert idx.purge_pod(POD_B.pod_identifier) > 0
+            cluster.sync_followers()  # adds AND the purge replay
+            victim = cluster.membership.ring().owner(keys[0])
+            cluster.kill(victim)
+            found = idx.lookup(keys)
+            for pods in found.values():
+                assert all(
+                    p.pod_identifier != POD_B.pod_identifier
+                    for p in pods
+                )
+        finally:
+            cluster.close()
+
+    def test_last_replica_is_never_removed(self):
+        cluster = LocalCluster(replica_ids=("only",))
+        try:
+            assert not cluster.membership.mark_dead("only", "test")
+            assert cluster.membership.alive() == ["only"]
+        finally:
+            cluster.close()
+
+    def test_heartbeat_marks_dead_then_revives(self):
+        cluster = LocalCluster()
+        try:
+            monitor = HeartbeatMonitor(cluster.membership, misses=2)
+            transport = cluster.transports["replica-1"]
+            transport.kill()
+            monitor.beat_once()
+            assert cluster.membership.is_alive("replica-1")  # 1 miss
+            monitor.beat_once()
+            assert not cluster.membership.is_alive("replica-1")
+            transport.revive()
+            monitor.beat_once()
+            assert cluster.membership.is_alive("replica-1")
+        finally:
+            cluster.close()
+
+
+# ---------------------------------------------------- replication
+
+
+class TestReplication:
+    def test_bootstrap_then_tail_with_watermark_skip(self, tmp_path):
+        """Follower warm-sync: sync_snapshot's dump covers everything
+        below the boundary; tailing resumes there, numbered records
+        BELOW the watermark are skipped (mirroring recovery), at or
+        above replay."""
+        primary_dir = str(tmp_path / "primary")
+        primary = ClusterReplica(
+            "primary",
+            index=InMemoryIndex(),
+            journal=Journal(primary_dir),
+        )
+        transport = LocalReplicaTransport(primary)
+        # Seq-carrying history (the replica-local event-plane mode).
+        primary.index.add([1], [11], [POD_A])
+        primary.journal.record_add("pod-a", 5, [1], [11], [POD_A])
+
+        follower_index = InMemoryIndex()
+        follower = ReplicationFollower(
+            "primary", primary_dir, follower_index
+        )
+        assert follower.bootstrap(transport) == 1
+        assert follower_index.lookup([11]) == {11: [POD_A]}
+
+        # Below-watermark record: its effect is ALREADY in the dump
+        # (idempotent anyway); the skip path must classify it.
+        primary.journal.record_add("pod-a", 3, [2], [12], [POD_A])
+        # At/above watermark: replays.
+        primary.journal.record_add("pod-a", 6, [3], [13], [POD_A])
+        follower.sync_once()
+        status = follower.status()
+        assert status["applied"] == 1 and status["skipped"] == 1
+        assert follower_index.lookup([13]) == {13: [POD_A]}
+        assert follower_index.lookup([13, 12]).get(12) is None
+        primary.close()
+
+    def test_standby_filter_trims_to_slice(self):
+        full_ring = HashRing(["replica-0", "replica-1", "replica-2"])
+        record_filter = standby_record_filter(full_ring, "replica-1")
+        from llm_d_kv_cache_manager_tpu.persistence.journal import (
+            OP_ADD,
+            JournalRecord,
+        )
+
+        keys = KEYS[:200]
+        record = JournalRecord(
+            op=OP_ADD,
+            pod_identifier="pod-a",
+            seq=0,
+            ts_ns=0,
+            engine_keys=list(keys),
+            request_keys=list(keys),
+            entries=[POD_A],
+        )
+        trimmed = record_filter(record)
+        assert trimmed is not None
+        expected = [
+            k
+            for k in keys
+            if "replica-1" in full_ring.owners(k, 2)
+        ]
+        assert trimmed.request_keys == expected
+        assert trimmed.engine_keys == expected  # pairs stay aligned
+        # A record fully outside the slice drops.
+        outside = [
+            k
+            for k in KEYS
+            if "replica-1" not in full_ring.owners(k, 2)
+        ][:5]
+        record2 = JournalRecord(
+            op=OP_ADD,
+            pod_identifier="pod-a",
+            seq=0,
+            ts_ns=0,
+            engine_keys=list(outside),
+            request_keys=list(outside),
+            entries=[POD_A],
+        )
+        assert record_filter(record2) is None
+
+    def test_mappings_only_records_follow_engine_key_ownership(self):
+        """A cross-owner engine->request mapping stub must reach the
+        ENGINE-key owner's standby too: after that owner dies,
+        get_request_key routes to the standby, and without the mapping
+        the router would classify the eviction as 'already gone' and
+        leave a stale entry scoring forever."""
+        from llm_d_kv_cache_manager_tpu.persistence.journal import (
+            OP_ADD,
+            JournalRecord,
+        )
+
+        full_ring = HashRing(["replica-0", "replica-1", "replica-2"])
+        # Find a pair owned on the rk side by someone whose top-2 does
+        # NOT include replica-1, while replica-1 stands by the ek side.
+        pair = next(
+            (ek, rk)
+            for ek in KEYS[:500]
+            for rk in KEYS[500:600]
+            if "replica-1" in full_ring.owners(ek, 2)
+            and "replica-1" not in full_ring.owners(rk, 2)
+        )
+        record = JournalRecord(
+            op=OP_ADD,
+            pod_identifier="",
+            seq=0,
+            ts_ns=0,
+            engine_keys=[pair[0]],
+            request_keys=[pair[1]],
+            entries=[],  # mappings-only
+        )
+        kept = standby_record_filter(full_ring, "replica-1")(record)
+        assert kept is not None
+        assert kept.engine_keys == [pair[0]]
+        assert kept.request_keys == [pair[1]]
+
+    def test_same_owner_pair_eviction_survives_failover(self, tmp_path):
+        """A pair whose engine and request keys share a PRIMARY owner
+        can still have different standbys: the engine-key standby must
+        inherit the mapping (RemoteIndex.add publishes mappings for
+        every pair, and the filter keys on either side), or a
+        post-failover eviction reads 'already gone' and the stale
+        entry scores forever."""
+        cluster = LocalCluster(journal_root=str(tmp_path))
+        full_ring = cluster.membership.full_ring
+        pair = next(
+            (ek, rk)
+            for ek in KEYS[:300]
+            for rk in KEYS[300:500]
+            if full_ring.owner(ek) == full_ring.owner(rk)
+            and full_ring.owners(ek, 2)[1] != full_ring.owners(rk, 2)[1]
+        )
+        try:
+            idx = cluster.remote_index
+            idx.add([pair[0]], [pair[1]], [POD_A])
+            cluster.sync_followers()
+            victim = full_ring.owner(pair[0])
+            cluster.kill(victim)
+            # The eviction must resolve through the failed-over
+            # engine-key mapping and actually clear the entry.
+            idx.evict(pair[0], [POD_A])
+            assert idx.lookup([pair[1], KEYS[0]]).get(pair[1]) is None
+        finally:
+            cluster.close()
+
+    def test_peer_purge_replay_is_slice_scoped(self, tmp_path):
+        """Replaying a PEER's pod-wide purge against the whole local
+        index would wipe admissions this replica applied to its OWN
+        slice after the purge.  The follower scopes the replay to the
+        peer's primary slice; the replica's own fresh entries
+        survive."""
+        cluster = LocalCluster(journal_root=str(tmp_path))
+        try:
+            idx = cluster.remote_index
+            keys = KEYS[:200]
+            idx.add(keys, keys, [POD_A])
+            cluster.sync_followers()  # standby copies of the adds
+            idx.purge_pod(POD_A.pod_identifier)
+            # Fresh post-purge claims land on their owners directly.
+            idx.add(keys, keys, [POD_A])
+            # NOW the followers replay their peers' [adds, purge]
+            # streams — the purge must only touch each peer's slice,
+            # never the fresh entries of the follower's own slice.
+            cluster.sync_followers()
+            found = idx.lookup(keys)
+            assert set(found) == set(keys)
+            # And a failover still serves the slice (the standby
+            # replay converged to the same state).
+            victim = cluster.membership.ring().owner(keys[0])
+            cluster.kill(victim)
+            assert set(idx.lookup(keys)) == set(keys)
+        finally:
+            cluster.close()
+
+    def test_followers_only_hold_standby_slice(self, tmp_path):
+        cluster = LocalCluster(journal_root=str(tmp_path))
+        try:
+            keys = KEYS[:400]
+            cluster.remote_index.add(keys, keys, [POD_A])
+            cluster.sync_followers()
+            full_ring = cluster.membership.full_ring
+            for replica_id, replica in cluster.replicas.items():
+                resident = {
+                    k for k, _ in replica.index.dump_entries()[0]
+                }
+                for k in resident:
+                    assert replica_id in full_ring.owners(k, 2)
+        finally:
+            cluster.close()
+
+
+# --------------------------------------- kvevents routing to owners
+
+
+def _stored_message(
+    pod: str, seq: int, block_hashes, token_ids, parent=None
+) -> Message:
+    batch = EventBatch(
+        ts=1.0,
+        events=[
+            BlockStored(
+                block_hashes=list(block_hashes),
+                parent_block_hash=parent,
+                token_ids=list(token_ids),
+                block_size=4,
+            )
+        ],
+    )
+    return Message(
+        topic=f"kv@{pod}@{MODEL}",
+        payload=batch.encode(),
+        pod_identifier=pod,
+        model_name=MODEL,
+        seq=seq,
+    )
+
+
+class TestEventRoutingToSliceOwners:
+    def test_pool_applies_through_remote_index(self):
+        """The unchanged kvevents pool drives the cluster: batched
+        admissions land on slice owners, chained parents resolve
+        across messages of one batch, evictions route two-hop."""
+        cluster = LocalCluster()
+        try:
+            db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+            pool = Pool(
+                cluster.remote_index, db, PoolConfig(concurrency=1)
+            )
+            pool.start()
+            tokens = list(range(1, 13))  # 3 blocks, chained
+            pool.add_task(
+                _stored_message("pod-a", 1, [101], tokens[:4])
+            )
+            pool.add_task(
+                _stored_message(
+                    "pod-a", 2, [102, 103], tokens[4:], parent=101
+                )
+            )
+            pool.drain()
+
+            expected_keys = db.tokens_to_kv_block_keys(
+                EMPTY_BLOCK_HASH, tokens, MODEL
+            )
+            found = cluster.remote_index.lookup(expected_keys)
+            assert set(found) == set(expected_keys)
+            ring = cluster.membership.ring()
+            for key in expected_keys:
+                owner = ring.owner(key)
+                local = cluster.replicas[owner].index.lookup([key])
+                assert key in local  # admission landed on its owner
+
+            # Evictions route through the engine-key mapping.
+            removal = EventBatch(
+                ts=2.0,
+                events=[BlockRemoved(block_hashes=[103])],
+            )
+            pool.add_task(
+                Message(
+                    topic=f"kv@pod-a@{MODEL}",
+                    payload=removal.encode(),
+                    pod_identifier="pod-a",
+                    model_name=MODEL,
+                    seq=3,
+                )
+            )
+            pool.drain()
+            remaining = cluster.remote_index.lookup(expected_keys)
+            assert expected_keys[2] not in remaining
+            assert expected_keys[1] in remaining
+            pool.shutdown()
+        finally:
+            cluster.close()
+
+
+# ------------------------------------------------- parity oracle
+
+
+def _make_indexer(index, fast=True):
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=4),
+            kvblock_index_config=IndexConfig(
+                in_memory_config=InMemoryIndexConfig(size=200_000)
+            ),
+            read_path_fast_lane=fast,
+            lookup_chunk_size=8,
+            score_memo_size=0,
+            cache_stats=False,
+        ),
+        tokenizer=WordTokenizer(),
+        kv_block_index=index,
+    )
+    indexer.run()
+    return indexer
+
+
+class TestScoreParityOracle:
+    @pytest.mark.parametrize("seed", [7, 41])
+    def test_cluster_scores_bit_identical_to_in_memory(self, seed):
+        """The acceptance oracle: a 3-replica in-process cluster must
+        return BIT-IDENTICAL scores to the single-process
+        InMemoryIndex on a randomized workload, through the real
+        scoring read path (fast lane AND straight lane)."""
+        rng = random.Random(seed)
+        cluster = LocalCluster(strict_wire=True)
+        single = _make_indexer(InMemoryIndex())
+        clustered = _make_indexer(cluster.remote_index)
+        straight = _make_indexer(cluster.remote_index, fast=False)
+        try:
+            db = single.token_processor
+            pods = [
+                PodEntry("pod-a", "hbm"),
+                PodEntry("pod-b", "host"),
+                PodEntry("pod-c", "shared_storage"),
+            ]
+            prompts = []
+            for i in range(30):
+                tokens = [
+                    rng.randrange(1, 500)
+                    for _ in range(rng.randrange(4, 40))
+                ]
+                prompts.append(tokens)
+                # Random pods claim random prefixes of the chain.
+                keys = db.tokens_to_kv_block_keys(
+                    EMPTY_BLOCK_HASH, tokens, MODEL
+                )
+                if not keys:
+                    continue
+                for pod in rng.sample(pods, rng.randrange(0, 4)):
+                    prefix = keys[: rng.randrange(1, len(keys) + 1)]
+                    single.kv_block_index.add(
+                        prefix, prefix, [pod]
+                    )
+                    cluster.remote_index.add(prefix, prefix, [pod])
+            for tokens in prompts:
+                prompt = words(tokens)
+                want = single.get_pod_scores(prompt, MODEL)
+                assert clustered.get_pod_scores(prompt, MODEL) == want
+                assert straight.get_pod_scores(prompt, MODEL) == want
+                # Pod-filtered scoring stays aligned too.
+                subset = ["pod-a", "pod-c"]
+                assert clustered.get_pod_scores(
+                    prompt, MODEL, subset
+                ) == single.get_pod_scores(prompt, MODEL, subset)
+        finally:
+            single.shutdown()
+            clustered.shutdown()
+            straight.shutdown()
+            cluster.close()
+
+    def test_scores_survive_failover(self, tmp_path):
+        """Scores for a killed replica's slice keep flowing (served by
+        the warm runner-up) — the cluster-smoke assertion in test
+        form."""
+        cluster = LocalCluster(journal_root=str(tmp_path))
+        clustered = _make_indexer(cluster.remote_index)
+        try:
+            db = clustered.token_processor
+            tokens = list(range(1, 41))
+            keys = db.tokens_to_kv_block_keys(
+                EMPTY_BLOCK_HASH, tokens, MODEL
+            )
+            cluster.remote_index.add(keys, keys, [POD_A])
+            cluster.sync_followers()
+            prompt = words(tokens)
+            before = clustered.get_pod_scores(prompt, MODEL)
+            assert before  # pod-a scored
+            victim = cluster.membership.ring().owner(keys[0])
+            cluster.kill(victim)
+            assert clustered.get_pod_scores(prompt, MODEL) == before
+        finally:
+            clustered.shutdown()
+            cluster.close()
+
+
+# ------------------------------------------------- wire + http
+
+
+class TestWireAndHttp:
+    def test_unknown_method_is_application_error(self):
+        replica = ClusterReplica("r0")
+        transport = LocalReplicaTransport(replica, strict_wire=True)
+        with pytest.raises(ReplicaError):
+            transport.call("no_such_method", [])
+
+    def test_http_replica_endpoint_with_token_gate(self):
+        from llm_d_kv_cache_manager_tpu.api.http_service import serve
+
+        indexer = Indexer(
+            IndexerConfig(cache_stats=False), tokenizer=WordTokenizer()
+        )
+        replica = ClusterReplica("r0", index=indexer.kv_block_index)
+        server = serve(
+            indexer,
+            host="127.0.0.1",
+            port=0,
+            admin_token="secret",
+            replica=replica,
+            cluster_status=lambda: {"role": "replica", "replica": "r0"},
+        )
+        port = server.server_address[1]
+        try:
+            good = HttpReplicaTransport(
+                f"http://127.0.0.1:{port}", token="secret"
+            )
+            assert good.call("ping", []) == "r0"
+            good.call("add", [[1], [11], [["pod-a", "hbm"]]])
+            assert good.call("get_request_key", [1]) == [1, 11]
+
+            bad = HttpReplicaTransport(f"http://127.0.0.1:{port}")
+            with pytest.raises(ReplicaUnavailable):
+                bad.call("ping", [])  # 403 without the token
+
+            import json
+            import urllib.request
+
+            payload = json.load(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/cluster"
+                )
+            )
+            assert payload["role"] == "replica"
+        finally:
+            server.shutdown()
+            indexer.shutdown()
+
+    def test_remote_index_over_http_transport(self):
+        from llm_d_kv_cache_manager_tpu.api.http_service import serve
+
+        indexer = Indexer(
+            IndexerConfig(cache_stats=False), tokenizer=WordTokenizer()
+        )
+        replica = ClusterReplica("r0", index=indexer.kv_block_index)
+        server = serve(
+            indexer, host="127.0.0.1", port=0, replica=replica
+        )
+        port = server.server_address[1]
+        try:
+            membership = ClusterMembership(
+                {
+                    "r0": HttpReplicaTransport(
+                        f"http://127.0.0.1:{port}"
+                    )
+                }
+            )
+            remote = RemoteIndex(membership)
+            remote.add([1, 2], [11, 12], [POD_A])
+            assert len(remote.lookup_chain([11, 12])) == 2
+            remote.evict(1, [POD_A])
+            assert remote.lookup([11, 12]).get(11) is None
+        finally:
+            server.shutdown()
+            indexer.shutdown()
+
+
+class TestDebugClusterRouterPayload:
+    def test_local_cluster_status_shape(self, tmp_path):
+        cluster = LocalCluster(journal_root=str(tmp_path))
+        try:
+            cluster.remote_index.add(KEYS[:10], KEYS[:10], [POD_A])
+            cluster.sync_followers()
+            status = cluster.status()
+            assert status["membership"]["ring_version"] == 0
+            assert len(status["membership"]["alive"]) == 3
+            assert len(status["replication"]) == 6  # 3 replicas x 2 peers
+            cluster.kill("replica-0")
+            status = cluster.status()
+            assert status["membership"]["failovers"] == 1
+            assert "replica-0" not in status["membership"]["alive"]
+        finally:
+            cluster.close()

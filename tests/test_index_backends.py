@@ -1,12 +1,18 @@
-"""One behavioral contract suite, parameterized over every index backend.
+"""ONE behavioral contract suite, parameterized over EVERY index backend.
 
 Mirrors the reference's testing idea (pkg/kvcache/kvblock/index_test.go
 ``testCommonIndexBehavior`` run against in-memory / cost-aware / redis):
-backends must be interchangeable.
+backends must be interchangeable.  The parity harness runs against the
+in-memory, cost-aware, instrumented, fake-redis, and REMOTE (3-replica
+in-process cluster over the strict wire codec) backends, so the
+``lookup`` / ``lookup_chain`` / batched-add / dump-restore contract
+cannot drift per backend — a backend that diverges fails here before
+any cluster or persistence test ever sees it.
 """
 
 import pytest
 
+from llm_d_kv_cache_manager_tpu.cluster import LocalCluster
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
     IndexConfig,
     PodEntry,
@@ -31,6 +37,8 @@ POD1 = PodEntry("pod-1", "hbm")
 POD1_HOST = PodEntry("pod-1", "host")
 POD2 = PodEntry("pod-2", "hbm")
 
+BACKENDS = ["in_memory", "cost_aware", "redis", "instrumented", "remote"]
+
 
 @pytest.fixture(scope="module")
 def resp_server():
@@ -39,9 +47,7 @@ def resp_server():
     server.close()
 
 
-@pytest.fixture(
-    params=["in_memory", "cost_aware", "redis", "instrumented"]
-)
+@pytest.fixture(params=BACKENDS)
 def index(request, resp_server):
     if request.param == "in_memory":
         yield InMemoryIndex(InMemoryIndexConfig(size=10_000))
@@ -51,6 +57,13 @@ def index(request, resp_server):
         )
     elif request.param == "instrumented":
         yield InstrumentedIndex(InMemoryIndex(InMemoryIndexConfig(size=10_000)))
+    elif request.param == "remote":
+        # 3 in-process replicas through the strict wire codec: the
+        # same method table the HTTP endpoint serves, so contract
+        # parity here covers the RPC serialization too.
+        cluster = LocalCluster(strict_wire=True)
+        yield cluster.remote_index
+        cluster.close()
     else:
         idx = RedisIndex(RedisIndexConfig(address=resp_server.address))
         yield idx
@@ -128,10 +141,6 @@ class TestIndexContract:
         assert index.purge_pod("no-such-pod") == 0
 
     def test_purge_pod_removes_every_tier(self, index):
-        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
-            PodEntry,
-        )
-
         tiers = [
             PodEntry(POD1.pod_identifier, "hbm"),
             PodEntry(POD1.pod_identifier, "host"),
@@ -148,21 +157,70 @@ class TestIndexContract:
         assert index.lookup([250]) == {250: [POD2]}
         assert index.get_request_key(150) == 250
 
-    def test_dump_restore_entries_part_of_contract(self, index):
-        """Every backend answers the persistence contract; the durable
-        Redis backend answers it with the documented no-op (state
-        already lives server-side), the in-process ones round-trip."""
+    # -- the modern contract surface (fast lane + batched apply + dump) --
+
+    def test_lookup_chain_aligned_and_truncated(self, index):
+        """lookup_chain is the fast lane's shape: aligned per-key pod
+        lists, truncated at the first key with no resident pods —
+        whether the backend overrides it (in-memory, redis, remote) or
+        inherits the default adapter."""
+        index.add([501, 502, 503], [601, 602, 603], [POD1, POD2])
+        chain = index.lookup_chain([601, 602, 603])
+        assert len(chain) == 3
+        for pods in chain:
+            assert set(pods) == {POD1, POD2}
+        # A missing key cuts the chain for every pod.
+        truncated = index.lookup_chain([601, 9999, 603])
+        assert len(truncated) == 1
+        assert index.lookup_chain([9999, 601]) == []
+
+    def test_lookup_chain_agrees_with_lookup(self, index):
+        """Chain results must be lookup's view of the same keys (the
+        scorer relies on either shape producing identical scores)."""
+        index.add([511, 512], [611, 612], [POD1])
+        keys = [611, 612, 613]
+        chain = index.lookup_chain(keys)
+        flat = index.lookup(keys)
+        for key, pods in zip(keys, chain):
+            assert set(pods) == set(flat[key])
+        assert len(chain) == 2  # 613 never added
+
+    def test_batched_apply_surface(self, index):
+        """add_mappings + add_entries_batch (the kvevents batched-apply
+        split) must equal a plain add; backends without the surface
+        are exercised through the applier's fallback path instead
+        (tests/test_read_path_fastlane.py)."""
+        if not (
+            callable(getattr(index, "add_mappings", None))
+            and callable(getattr(index, "add_entries_batch", None))
+        ):
+            pytest.skip("backend has no batched-apply surface")
+        index.add_mappings([701, 702], [801, 802])
+        index.add_entries_batch(
+            [([801], [POD1]), ([802], [POD1, POD2])]
+        )
+        assert index.get_request_key(701) == 801
+        found = index.lookup([801, 802])
+        assert found[801] == [POD1]
+        assert set(found[802]) == {POD1, POD2}
+        # The mapping resolves evictions exactly like add's would.
+        index.evict(701, [POD1])
+        assert index.lookup([802, 801]).get(801) is None
+
+    def test_dump_restore_round_trip(self, index):
+        """Every backend answers the persistence contract with a real
+        round trip — including Redis (SCAN-based, replacing the old
+        documented no-op) and the remote cluster (concatenated replica
+        dumps routed back to their owners)."""
         index.add([160, 161], [260, 261], [POD1, POD2])
         block_entries, engine_map = index.dump_entries()
+        assert {k for k, _ in block_entries} >= {260, 261}
+        assert dict(engine_map)[160] == 260
         restored = index.restore_entries(block_entries, engine_map)
-        if isinstance(index, RedisIndex):
-            assert (block_entries, engine_map) == ([], [])
-            assert restored == 0
-        else:
-            assert {k for k, _ in block_entries} >= {260, 261}
-            assert dict(engine_map)[160] == 260
-            assert restored == len(block_entries)  # idempotent re-add
-            assert set(index.lookup([260, 261])) == {260, 261}
+        assert restored == len(
+            [e for _, e in block_entries if e]
+        )  # idempotent re-add
+        assert set(index.lookup([260, 261])) == {260, 261}
 
 
 class TestInMemorySpecifics:
@@ -207,7 +265,6 @@ class TestInMemorySpecifics:
         index._shard(22).get(22).remove_all([POD1])
         found = index.lookup([21, 22, 23])
         assert found == {21: [POD1]}
-
 
     def test_lookup_batched_get_refreshes_recency(self):
         """lookup batches its locking (LRUCache.peek_many, then one

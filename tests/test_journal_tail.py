@@ -1,0 +1,222 @@
+"""The journal follow API: torn tails, rotation, compaction, bounds.
+
+``persistence.journal.tail`` is the enabling primitive for replication
+followers (docs/replication.md): these tests pin the follow contract —
+a torn tail in the ACTIVE segment holds (and the record is returned
+once whole), a sealed segment's corruption abandons the rest of that
+segment only, rotation and compaction are followed seamlessly, and a
+bounded call resumes exactly where it stopped.
+"""
+
+import os
+import struct
+import zlib
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import PodEntry
+from llm_d_kv_cache_manager_tpu.persistence.journal import (
+    OP_ADD,
+    OP_PURGE,
+    Journal,
+    JournalRecord,
+    TailPosition,
+    list_segments,
+    tail,
+)
+
+POD = PodEntry("pod-a", "hbm")
+
+_RECORD_HEADER = struct.Struct(">II")
+
+
+def _record(i: int, seq: int = 0) -> JournalRecord:
+    return JournalRecord(
+        op=OP_ADD,
+        pod_identifier="pod-a",
+        seq=seq,
+        ts_ns=1,
+        engine_keys=[1000 + i],
+        request_keys=[2000 + i],
+        entries=[POD],
+    )
+
+
+def _frame(record: JournalRecord) -> bytes:
+    body = record.encode()
+    return (
+        _RECORD_HEADER.pack(len(body), zlib.crc32(body) & 0xFFFFFFFF)
+        + body
+    )
+
+
+def _append_raw(directory: str, data: bytes) -> str:
+    """Append bytes to the newest segment file directly (simulating a
+    writer whose append is partially visible)."""
+    segments = list_segments(directory)
+    path = segments[-1][1]
+    with open(path, "ab") as handle:
+        handle.write(data)
+    return path
+
+
+class TestTailBasics:
+    def test_follow_from_start_and_resume(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 1, [1], [2], [POD])
+        journal.record_add("pod-a", 2, [3], [4], [POD])
+
+        records, position = tail(str(tmp_path))
+        assert [r.seq for r in records] == [1, 2]
+
+        journal.record_evict("pod-a", 3, [1], [POD])
+        more, position2 = tail(str(tmp_path), position)
+        assert len(more) == 1 and more[0].seq == 3
+        # Idle poll: nothing new, position stable.
+        empty, position3 = tail(str(tmp_path), position2)
+        assert empty == [] and position3 == position2
+        journal.close()
+
+    def test_empty_directory(self, tmp_path):
+        records, position = tail(str(tmp_path))
+        assert records == [] and position == TailPosition(0, 0)
+
+    def test_boundary_start_skips_covered_segments(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 1, [1], [2], [POD])
+        boundary, watermarks, _ = journal.snapshot_boundary()
+        journal.record_add("pod-a", 2, [3], [4], [POD])
+
+        records, _ = tail(str(tmp_path), TailPosition(boundary, 0))
+        assert [r.seq for r in records] == [2]
+        assert watermarks == {"pod-a": 1}
+        journal.close()
+
+    def test_max_records_resumes_mid_segment(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        for i in range(5):
+            journal.record_add("pod-a", i + 1, [i], [i], [POD])
+        first, position = tail(str(tmp_path), max_records=2)
+        assert [r.seq for r in first] == [1, 2]
+        rest, _ = tail(str(tmp_path), position)
+        assert [r.seq for r in rest] == [3, 4, 5]
+        journal.close()
+
+    def test_purge_records_flow_through(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 1, [1], [2], [POD])
+        journal.record_purge("pod-a")
+        records, _ = tail(str(tmp_path))
+        assert [r.op for r in records] == [OP_ADD, OP_PURGE]
+        assert records[1].pod_identifier == "pod-a"
+        journal.close()
+
+
+class TestTornTails:
+    def test_active_torn_tail_holds_then_completes(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 1, [1], [2], [POD])
+        journal.close()
+
+        frame = _frame(_record(7))
+        _append_raw(str(tmp_path), frame[: len(frame) - 5])
+
+        records, position = tail(str(tmp_path))
+        assert len(records) == 1  # the whole record only
+        held = position
+
+        # Writer finishes the append: the SAME position now yields it.
+        _append_raw(str(tmp_path), frame[len(frame) - 5:])
+        more, position2 = tail(str(tmp_path), held)
+        assert len(more) == 1
+        assert more[0].engine_keys == [1007]
+        assert position2.offset > held.offset
+
+    def test_crc_corruption_in_active_segment_holds(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 1, [1], [2], [POD])
+        journal.close()
+        frame = bytearray(_frame(_record(8)))
+        frame[-1] ^= 0xFF  # body corrupted, CRC now mismatches
+        _append_raw(str(tmp_path), bytes(frame))
+
+        records, position = tail(str(tmp_path))
+        assert len(records) == 1
+        again, position2 = tail(str(tmp_path), position)
+        assert again == [] and position2 == position
+
+    def test_sealed_torn_tail_abandons_segment(self, tmp_path):
+        """A higher-id segment exists: the torn record can never
+        complete, so the follower moves on (stop-don't-skip applies to
+        the REST of the sealed segment, not the whole journal)."""
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 1, [1], [2], [POD])
+        journal.close()
+        frame = _frame(_record(9))
+        _append_raw(str(tmp_path), frame[: len(frame) - 3])
+
+        # A fresh Journal seals the torn segment by starting a new one.
+        journal2 = Journal(str(tmp_path))
+        journal2.record_add("pod-a", 2, [5], [6], [POD])
+
+        records, position = tail(str(tmp_path))
+        assert [r.seq for r in records] == [1, 2]
+        # Cursor sits in the NEW segment now.
+        assert position.segment_id == list_segments(str(tmp_path))[-1][0]
+        journal2.close()
+
+    def test_undecodable_but_whole_record_is_skipped(self, tmp_path):
+        journal = Journal(str(tmp_path))
+        journal.record_add("pod-a", 1, [1], [2], [POD])
+        journal.close()
+        body = b"\x00"  # valid CBOR int, wrong record shape
+        _append_raw(
+            str(tmp_path),
+            _RECORD_HEADER.pack(len(body), zlib.crc32(body) & 0xFFFFFFFF)
+            + body,
+        )
+        _append_raw(str(tmp_path), _frame(_record(3, seq=2)))
+        records, _ = tail(str(tmp_path))
+        # The garbage record is skipped (it will never change); the
+        # good one behind it still arrives.
+        assert [r.seq for r in records] == [1, 2]
+
+
+class TestRotationAndCompaction:
+    def test_rotation_mid_follow(self, tmp_path):
+        journal = Journal(str(tmp_path), segment_max_bytes=1)
+        journal.record_add("pod-a", 1, [1], [2], [POD])
+        records, position = tail(str(tmp_path))
+        assert len(records) == 1
+        # Every append rotates at this size: new records land in new
+        # segment files; the cursor follows.
+        journal.record_add("pod-a", 2, [3], [4], [POD])
+        journal.record_add("pod-a", 3, [5], [6], [POD])
+        more, position2 = tail(str(tmp_path), position)
+        assert [r.seq for r in more] == [2, 3]
+        assert position2.segment_id > position.segment_id
+        journal.close()
+
+    def test_compaction_of_cursor_segment_jumps_forward(self, tmp_path):
+        journal = Journal(str(tmp_path), segment_max_bytes=1)
+        journal.record_add("pod-a", 1, [1], [2], [POD])
+        _, position = tail(str(tmp_path))
+        journal.record_add("pod-a", 2, [3], [4], [POD])
+        # Compact everything below the newest segment — including the
+        # segment the cursor points into.
+        newest = list_segments(str(tmp_path))[-1][0]
+        removed = journal.compact_before(newest)
+        assert removed >= 1
+        records, position2 = tail(str(tmp_path), position)
+        assert [r.seq for r in records] == [2]
+        assert position2.segment_id >= newest
+        journal.close()
+
+    def test_gap_in_segment_ids_is_followed(self, tmp_path):
+        journal = Journal(str(tmp_path), segment_max_bytes=1)
+        for seq in (1, 2, 3):
+            journal.record_add("pod-a", seq, [seq], [seq], [POD])
+        segments = list_segments(str(tmp_path))
+        # Remove a MIDDLE segment (manual compaction hole).
+        os.unlink(segments[1][1])
+        records, _ = tail(str(tmp_path))
+        assert [r.seq for r in records] == [1, 3]
+        journal.close()

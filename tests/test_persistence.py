@@ -287,6 +287,132 @@ class TestRecovery:
         all_keys = keys + [15]
         assert restored.lookup(all_keys) == source.lookup(all_keys)
 
+    def test_replay_applies_purge_in_order(self, tmp_path):
+        """OP_PURGE closes a real gap: a purge_pod between the snapshot
+        and the crash used to be lost on replay — the replayed adds
+        resurrected exactly the entries the operator dropped.  The
+        purge record must replay in journal order (adds before it come
+        back, adds after it survive)."""
+        config = PersistenceConfig(directory=str(tmp_path))
+        manager = PersistenceManager(config)
+        source = make_index("in_memory")
+        source.add([1, 2], [11, 12], [POD_A, POD_B])
+        manager.journal.record_add(
+            "pod-a", 1, [1, 2], [11, 12], [POD_A, POD_B]
+        )
+        source.purge_pod(POD_B.pod_identifier)
+        manager.journal.record_purge(POD_B.pod_identifier)
+        # POD_B re-claims one key AFTER the purge: must survive.
+        source.add([2], [12], [POD_B])
+        manager.journal.record_add("pod-b", 2, [2], [12], [POD_B])
+        manager.close()
+
+        restored = make_index("in_memory")
+        recover(restored, config)
+        assert restored.lookup([11, 12]) == source.lookup([11, 12])
+        assert all(
+            p.pod_identifier != POD_B.pod_identifier
+            for p in restored.lookup([11]).get(11, [])
+        )
+        assert POD_B in restored.lookup([12])[12]
+
+    def test_boundary_skips_uncompacted_covered_segments(self, tmp_path):
+        """Snapshots carry their journal boundary: when compaction
+        failed (crash between publish and compact), the covered
+        pre-boundary segments must be skipped WHOLESALE — an
+        uncompacted pre-boundary purge would otherwise replay against
+        restored state whose covering re-adds the watermark skip
+        elides."""
+        config = PersistenceConfig(directory=str(tmp_path))
+        manager = PersistenceManager(config)
+        source = make_index("in_memory")
+        # History: purge pod-a, then re-admit it with seq<=watermark.
+        manager.journal.record_purge(POD_A.pod_identifier)
+        source.add([1], [11], [POD_A])
+        manager.journal.record_add("pod-a", 5, [1], [11], [POD_A])
+        info = manager.snapshot(source)
+        assert info.journal_boundary is not None
+        # Simulate the failed compaction: resurrect a covered segment
+        # below the boundary holding the purge + re-add.
+        import shutil
+
+        from llm_d_kv_cache_manager_tpu.persistence.journal import (
+            list_segments,
+        )
+
+        survivors = list_segments(config.journal_dir)
+        stale = Journal(str(tmp_path / "stale"))
+        stale.record_purge(POD_A.pod_identifier)
+        stale.record_add("pod-a", 5, [1], [11], [POD_A])
+        stale.close()
+        for segment_id, path in list_segments(str(tmp_path / "stale")):
+            low_id = info.journal_boundary - 1
+            target = os.path.join(
+                config.journal_dir,
+                f"segment-{low_id:012d}.kvj",
+            )
+            assert all(sid != low_id for sid, _ in survivors)
+            shutil.copy(path, target)
+        manager.close()
+
+        restored = make_index("in_memory")
+        report = recover(restored, config)
+        # The covered segment (purge + watermark-skippable re-add)
+        # never replays: pod-a's snapshot state survives.
+        assert restored.lookup([11]) == {11: [POD_A]}
+        assert report.records_replayed == 0
+
+    def test_recovery_gates_on_durable_backend(self, tmp_path):
+        """Startup recovery must never pipeline a file snapshot or a
+        journal replay into a durable (server-side, shared) backend —
+        the server is authoritative (docs/persistence.md §6)."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+            RedisIndexConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+            RedisIndex,
+        )
+        from tests.helpers.miniresp import MiniRespServer
+
+        config = PersistenceConfig(directory=str(tmp_path))
+        manager = PersistenceManager(config)
+        stale_state = make_index("in_memory")
+        stale_state.add([1], [11], [POD_A])
+        manager.journal.record_add("pod-a", 1, [1], [11], [POD_A])
+        manager.snapshot(stale_state)
+        manager.close()
+
+        server = MiniRespServer()
+        try:
+            index = RedisIndex(RedisIndexConfig(address=server.address))
+            report = recover(index, config)
+            assert report.status == "cold"
+            assert report.block_keys_restored == 0
+            assert report.records_replayed == 0
+            # Nothing was resurrected into the server.
+            assert index.lookup([11]) == {}
+        finally:
+            server.close()
+
+    def test_compact_keep_last_retains_newest_segments(self, tmp_path):
+        journal = Journal(str(tmp_path), segment_max_bytes=1)
+        for seq in range(1, 7):  # one segment per append at this size
+            journal.record_add("pod-a", seq, [seq], [seq], [POD_A])
+        from llm_d_kv_cache_manager_tpu.persistence.journal import (
+            list_segments,
+            tail,
+        )
+
+        assert len(list_segments(str(tmp_path))) >= 6
+        removed = journal.compact_keep_last(2)
+        assert removed >= 4
+        assert len(list_segments(str(tmp_path))) == 2
+        # The retained suffix still tails cleanly.
+        records, _ = tail(str(tmp_path))
+        assert [r.seq for r in records] == [5, 6]
+        assert journal.compact_keep_last(0) == 0  # disabled
+        journal.close()
+
     def test_replay_skips_records_strictly_below_watermark(
         self, tmp_path
     ):
@@ -372,17 +498,32 @@ class TestBackendContractExtensions:
         assert other.restore_entries(entries, emap) == 1
         assert other.lookup([11]) == inner.lookup([11])
 
-    def test_redis_backend_is_documented_noop(self):
+    def test_redis_backend_answers_dump_restore(self):
+        """The long-documented Redis no-op was replaced by a SCAN-based
+        dump when the backend was promoted to replica duty
+        (docs/replication.md); the round trip must hold like every
+        other backend's."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+            RedisIndexConfig,
+        )
         from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
             RedisIndex,
         )
+        from tests.helpers.miniresp import MiniRespServer
 
-        # No server needed: the no-op must not touch the client.
-        dump = RedisIndex.dump_entries
-        restore = RedisIndex.restore_entries
-        assert dump(object()) == ([], [])
-        assert restore(object(), [(1, [POD_A])], [(1, 1)]) == 0
-        assert "no-op" in dump.__doc__
+        server = MiniRespServer()
+        try:
+            index = RedisIndex(RedisIndexConfig(address=server.address))
+            index.add([21, 22], [121, 122], [POD_A])
+            entries, emap = index.dump_entries()
+            assert {k for k, _ in entries} == {121, 122}
+            assert dict(emap) == {21: 121, 22: 122}
+            index._client.execute("FLUSHALL")
+            assert index.restore_entries(entries, emap) == 2
+            assert set(index.lookup([121, 122])) == {121, 122}
+            assert index.get_request_key(21) == 121
+        finally:
+            server.close()
 
 
 class TestPoolJournalTap:
