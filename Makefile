@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke offload-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -85,6 +85,16 @@ cachestats-smoke:
 # flips when the RTT estimator is inflated (docs/tiering.md).
 tiering-smoke:
 	$(CPU_ENV) $(PYTHON) hack/tiering_smoke.py
+
+# Host-offload smoke (same invocation as CI's "Host-offload smoke"
+# step): the staging engine moves real bytes — store->evict->load
+# round trip bit-identical through the per-chip lanes, a demotion
+# cycle pages group bytes hbm->host->shared_storage with the index
+# tier AND the live score following each rung, and the advisor's
+# read/write RTT estimators show measured transfers in /debug/tiering
+# (docs/host-offload.md).
+offload-smoke:
+	$(CPU_ENV) $(PYTHON) hack/offload_smoke.py
 
 # Cluster smoke (same invocation as CI's "Cluster smoke" step): 3
 # in-process replicas + a router HTTP service over the RemoteIndex —

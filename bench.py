@@ -256,6 +256,10 @@ def emit_result(full: dict, probe: dict) -> None:
             "hybrid_ok": col.get("hybrid_le_min_pure"),
             "advice": (col.get("advice") or {}).get("action"),
         }
+    host_offload = detail.get("host_offload") or {}
+    # The regime pre-computes its compact block (bench_host_offload
+    # "headline"); pass it through untouched.
+    host_offload_compact = host_offload.get("headline")
     event_storm = detail.get("event_storm") or {}
     event_storm_compact = None
     if event_storm and "n_pods" in event_storm:
@@ -304,6 +308,7 @@ def emit_result(full: dict, probe: dict) -> None:
         "read_path": read_path_compact,
         "cache_analytics": cache_analytics_compact,
         "tiered_churn": tiered_churn_compact,
+        "host_offload": host_offload_compact,
         "event_storm": event_storm_compact,
         "indexer_restart": detail.get("indexer_restart"),
         "replica_scaleout": replica_scaleout_compact,
@@ -320,6 +325,7 @@ def emit_result(full: dict, probe: dict) -> None:
         "replica_scaleout",
         "indexer_restart",
         "event_storm",
+        "host_offload",
         "tiered_churn",
         "cache_analytics",
         "read_path",
@@ -354,6 +360,21 @@ from llm_d_kv_cache_manager_tpu.kvevents.events import (
 from llm_d_kv_cache_manager_tpu.kvevents.pool import Message, Pool, PoolConfig
 from llm_d_kv_cache_manager_tpu.metrics.collector import counter_total
 from llm_d_kv_cache_manager_tpu.models import llama
+from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import (
+    KVCachePool,
+    KVCachePoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.native.engine import (
+    JobStatus as OffloadJobStatus,
+)
+from llm_d_kv_cache_manager_tpu.offload.spec import (
+    TPUOffloadConnector,
+    TPUOffloadSpec,
+)
+from llm_d_kv_cache_manager_tpu.offload.worker import (
+    group_blocks_per_file,
+    host_dtype,
+)
 from llm_d_kv_cache_manager_tpu.tokenization.pool import (
     TokenizationPoolConfig,
 )
@@ -2890,6 +2911,295 @@ def maybe_bench_tiered_churn(
         return {"error": detail[:300]}
 
 
+# ---------------- host_offload: staging-engine data-plane regime -------
+
+# A compact but real KV geometry: 64 KiB per block across layers, so a
+# 32-block transfer moves 2 MiB through the actual gather -> staging ->
+# file path without dominating the CPU smoke budget.
+HO_POOL_BLOCKS = 32
+HO_BLOCKS_PER_FILE = 4
+HO_LANES_SWEEP = (1, 2, 4)
+
+
+def _ho_pool_config() -> KVCachePoolConfig:
+    return KVCachePoolConfig(
+        num_layers=4,
+        num_blocks=HO_POOL_BLOCKS,
+        block_size=BLOCK_SIZE,
+        num_kv_heads=4,
+        head_dim=64,
+        dtype="bfloat16",
+    )
+
+
+def _ho_fill(pool: KVCachePool, block_ids, seed: int):
+    rng = np.random.default_rng(seed)
+    c = pool.config
+    for block_id in block_ids:
+        pool.write_block(
+            block_id,
+            rng.standard_normal(
+                (c.num_layers, 2, c.block_size, c.num_kv_heads, c.head_dim)
+            ).astype(host_dtype(c.dtype)),
+        )
+
+
+def _ho_roundtrip(
+    device, root: str, lanes: int, rank: int, seed: int
+) -> dict:
+    """One chip's store + load round trip through the offload
+    connector (staged when lanes > 0, the one-shot oracle at 0);
+    returns wall times, bytes, and a parity verdict."""
+    pool = KVCachePool(
+        _ho_pool_config(),
+        sharding=jax.sharding.SingleDeviceSharding(device),
+    )
+    spec = TPUOffloadSpec(
+        shared_storage_path=root,
+        model_name="bench/offload",
+        device_block_size=BLOCK_SIZE,
+        offloaded_block_size=BLOCK_SIZE * HO_BLOCKS_PER_FILE,
+        threads_per_chip=4,
+        staging_lanes=lanes,
+        rank=rank,  # each chip writes its own shard tree
+    )
+    connector = TPUOffloadConnector(spec, pool)
+    try:
+        half = HO_POOL_BLOCKS // 2
+        block_ids = list(range(half))
+        _ho_fill(pool, block_ids, seed)
+        file_hashes = [
+            0x1000 + seed * 0x100 + i
+            for i in range(half // HO_BLOCKS_PER_FILE)
+        ]
+        groups = group_blocks_per_file(
+            file_hashes, block_ids, HO_BLOCKS_PER_FILE
+        )
+        nbytes = half * pool.block_nbytes
+
+        t0 = time.perf_counter()
+        connector.store_handler.transfer_async(1, groups)
+        store_ok = (
+            connector.store_handler.wait(1) == OffloadJobStatus.SUCCEEDED
+        )
+        store_s = time.perf_counter() - t0
+
+        target_ids = list(range(half, 2 * half))
+        t0 = time.perf_counter()
+        connector.load_handler.transfer_async(
+            2,
+            group_blocks_per_file(
+                file_hashes, target_ids, HO_BLOCKS_PER_FILE
+            ),
+        )
+        load_ok = (
+            connector.load_handler.wait(2) == OffloadJobStatus.SUCCEEDED
+        )
+        load_s = time.perf_counter() - t0
+        parity = store_ok and load_ok and bool(
+            np.array_equal(
+                pool.gather_to_host(block_ids),
+                pool.gather_to_host(target_ids),
+            )
+        )
+        return {
+            "store_s": store_s,
+            "load_s": load_s,
+            "nbytes": nbytes,
+            "parity": parity,
+        }
+    finally:
+        connector.close()
+
+
+def bench_host_offload(t_miss: Optional[float] = None) -> dict:
+    """detail.host_offload regime (docs/host-offload.md):
+
+    1. **staging A/B** — the same store+load round trip through the
+       one-shot oracle (lanes=0) and the staged pipeline (lanes=2),
+       bytes verified both ways;
+    2. **lanes sweep x chips** — every local device runs its own
+       staged round trip concurrently (per-chip trees, rank-sharded),
+       swept over lanes-per-chip: the MULTICHIP per-chip I/O scaling
+       cell;
+    3. **TTFT** — offload-hit (measured staged load) vs recompute vs
+       advisor-hybrid, with the advisor's estimator fed by the REAL
+       transfers this regime just ran, not simulated RTTs.
+    """
+    from llm_d_kv_cache_manager_tpu.tiering import (
+        AdvisorConfig,
+        ComputeOrLoadAdvisor,
+    )
+
+    result: dict = {}
+    root = tempfile.mkdtemp(prefix="kvtpu-bench-offload-")
+    devices = jax.local_devices()
+    try:
+        # -- cell 1: staged vs one-shot A/B on chip 0 --
+        oneshot = _ho_roundtrip(
+            devices[0], os.path.join(root, "oneshot"), 0, 0, seed=1
+        )
+        staged = _ho_roundtrip(
+            devices[0], os.path.join(root, "staged"), 2, 0, seed=1
+        )
+        nbytes = staged["nbytes"]
+
+        def _mbps(cell, key):
+            seconds = max(cell[key], 1e-9)
+            return round(cell["nbytes"] / seconds / 1e6, 1)
+
+        result["staging_ab"] = {
+            "payload_mb": round(nbytes / 1e6, 2),
+            "oneshot_store_mbps": _mbps(oneshot, "store_s"),
+            "staged_store_mbps": _mbps(staged, "store_s"),
+            "oneshot_load_mbps": _mbps(oneshot, "load_s"),
+            "staged_load_mbps": _mbps(staged, "load_s"),
+            "parity": oneshot["parity"] and staged["parity"],
+        }
+
+        # -- cell 2: MULTICHIP lanes-per-chip sweep --
+        # Untimed warmup round trip per chip first: each device's
+        # first gather/scatter pays XLA compilation, which would
+        # otherwise be billed entirely to the sweep's first lane
+        # count.
+        warm_threads = [
+            threading.Thread(
+                target=_ho_roundtrip,
+                args=(d, os.path.join(root, "warm"), 1, i, 99),
+            )
+            for i, d in enumerate(devices)
+        ]
+        for thread in warm_threads:
+            thread.start()
+        for thread in warm_threads:
+            thread.join()
+        sweep = []
+        for lanes in HO_LANES_SWEEP:
+            lane_root = os.path.join(root, f"lanes_{lanes}")
+            cells = [None] * len(devices)
+
+            def run_chip(idx, device, lane_count=lanes, out=cells,
+                         base=lane_root):
+                out[idx] = _ho_roundtrip(
+                    device, base, lane_count, idx, seed=2 + idx
+                )
+
+            threads = [
+                threading.Thread(target=run_chip, args=(i, d))
+                for i, d in enumerate(devices)
+            ]
+            wall0 = time.perf_counter()
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join()
+            wall = time.perf_counter() - wall0
+            total_bytes = sum(c["nbytes"] for c in cells) * 2  # both ways
+            sweep.append(
+                {
+                    "lanes_per_chip": lanes,
+                    "chips": len(devices),
+                    "wall_s": round(wall, 4),
+                    "aggregate_mbps": round(
+                        total_bytes / max(wall, 1e-9) / 1e6, 1
+                    ),
+                    "parity": all(c["parity"] for c in cells),
+                }
+            )
+        best = max(sweep, key=lambda c: c["aggregate_mbps"])
+        result["multichip_lanes_sweep"] = {
+            "cells": sweep,
+            "best_lanes": best["lanes_per_chip"],
+            "best_aggregate_mbps": best["aggregate_mbps"],
+        }
+
+        # -- cell 3: TTFT offload-hit vs recompute vs advisor-hybrid --
+        ho_cfg = _ho_pool_config()
+        pool_bytes_per_block = (
+            ho_cfg.num_layers
+            * 2
+            * ho_cfg.block_size
+            * ho_cfg.num_kv_heads
+            * ho_cfg.head_dim
+            * jnp.dtype(ho_cfg.dtype).itemsize
+        )
+        prefix_blocks = HO_POOL_BLOCKS // 2
+        measured_load_s = staged["load_s"]
+        prefill_rate = (
+            TOTAL_TOKENS / t_miss
+            if t_miss and t_miss > 0
+            else TOTAL_TOKENS / CAL_MISS_S
+        )
+        advisor = ComputeOrLoadAdvisor(
+            AdvisorConfig(
+                bytes_per_block=pool_bytes_per_block,
+                block_tokens=BLOCK_SIZE,
+                prefill_tokens_per_s=prefill_rate,
+            )
+        )
+        # Feed the estimator with THIS regime's measured transfers.
+        advisor.observe_load(nbytes, staged["load_s"])
+        advisor.observe_load(oneshot["nbytes"], oneshot["load_s"])
+        advisor.observe_store(nbytes, staged["store_s"])
+        advice = advisor.advise(prefix_blocks)
+        suffix_s = SUFFIX_TOKENS / prefill_rate
+        ttft_hit = measured_load_s + suffix_s
+        ttft_recompute = (
+            prefix_blocks * BLOCK_SIZE + SUFFIX_TOKENS
+        ) / prefill_rate
+        hybrid_core = (
+            advice.hybrid_s
+            if advice.hybrid_s is not None
+            else min(advice.load_s, advice.recompute_s)
+        )
+        ttft_hybrid = hybrid_core + suffix_s
+        result["ttft"] = {
+            "prefix_blocks": prefix_blocks,
+            "prefix_bytes": prefix_blocks * pool_bytes_per_block,
+            "rtt_source": "measured_staging_path",
+            "prefill_tokens_per_s": round(prefill_rate, 1),
+            "prefill_source": (
+                "measured" if t_miss and t_miss > 0 else "calibrated"
+            ),
+            "ttft_offload_hit_s": round(ttft_hit, 4),
+            "ttft_recompute_s": round(ttft_recompute, 4),
+            "ttft_hybrid_s": round(ttft_hybrid, 4),
+            "advice": advice.to_dict(),
+            "advisor_rtt": advisor.stats()["rtt"],
+        }
+        # The compact headline block the driver sees (emit_result).
+        result["headline"] = {
+            "staged_store_mbps": result["staging_ab"]["staged_store_mbps"],
+            "staged_load_mbps": result["staging_ab"]["staged_load_mbps"],
+            "parity": result["staging_ab"]["parity"],
+            "chips": len(devices),
+            "best_lanes": best["lanes_per_chip"],
+            "best_aggregate_mbps": best["aggregate_mbps"],
+            "ttft_hit_s": result["ttft"]["ttft_offload_hit_s"],
+            "ttft_recompute_s": result["ttft"]["ttft_recompute_s"],
+            "ttft_hybrid_s": result["ttft"]["ttft_hybrid_s"],
+            "advice": advice.action,
+        }
+        return result
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def maybe_bench_host_offload(
+    context: str, t_miss: Optional[float] = None
+) -> dict:
+    """bench_host_offload under the degrade contract."""
+    if _over_budget(reserve_s=90.0):
+        return {"truncated": True}
+    _progress(f"{context}: host_offload regime (staging data plane)")
+    try:
+        return bench_host_offload(t_miss)
+    except Exception as exc:  # noqa: BLE001 — optional layer
+        detail = f"{type(exc).__name__}: {exc}"
+        _progress(f"host_offload failed: {detail}")
+        return {"error": detail[:300]}
+
+
 # ---------------- event_storm: fleet-scale event-plane regime ----------
 
 _STORM_TINY = bool(os.environ.get("KVTPU_BENCH_TINY"))
@@ -3881,6 +4191,12 @@ def main() -> None:
         "detail.tiered_churn", readback_rtt
     )
 
+    # detail.host_offload: the staging-engine data plane — staged vs
+    # one-shot A/B, the MULTICHIP lanes-per-chip sweep, and TTFT
+    # offload-hit vs recompute vs advisor-hybrid priced from the
+    # measured transfers (docs/host-offload.md).
+    host_offload = maybe_bench_host_offload("detail.host_offload", t_miss)
+
     # detail.event_storm: fleet-scale event-plane regime (consolidated
     # poller vs thread-per-pod, per-pod fairness, gap->resync),
     # device-free.
@@ -3940,6 +4256,7 @@ def main() -> None:
                 "read_path": read_path,
                 "cache_analytics": cache_analytics,
                 "tiered_churn": tiered_churn,
+                "host_offload": host_offload,
                 "event_storm": event_storm,
                 "indexer_restart": indexer_restart,
                 "replica_scaleout": replica_scaleout,

@@ -1,0 +1,337 @@
+"""CI smoke gate for the XLA host-offload staging engine.
+
+End-to-end over the REAL data plane (docs/host-offload.md):
+
+* **store -> evict -> load round trip** through the per-chip staging
+  lanes: pool blocks staged to shared-storage files (atomic layout),
+  the pool overwritten (eviction stand-in), then paged back through
+  the staged load pipeline — bytes bit-identical;
+* **demotion moves bytes**: a DemotionWorker cycle over the
+  StagedDemotionTarget pages the group hbm -> host (readable from the
+  HostTierCache) and then host -> shared_storage (readable from the
+  file), with the medium-tagged events riding the real kvevents pool
+  so the index tier AND the live score follow each rung
+  (1.0 -> 0.8 -> 0.5 per block);
+* **measured RTT feeds the advisor**: `/debug/tiering` shows read- and
+  write-side estimator observations from the real transfers (not
+  simulated), and the writeback gauge is on `/metrics`.
+
+Run: ``python hack/offload_smoke.py`` (CI step "Host-offload smoke",
+``make offload-smoke``).  Prints "offload smoke completed successfully"
+on success; any assertion exits non-zero.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TOKENIZERS_PARALLELISM", "false")
+os.environ.setdefault("CACHESTATS_SAMPLE_RATE", "1")
+os.environ.setdefault("TIERING_REFRESH_S", "0")
+
+import numpy as np  # noqa: E402
+
+from llm_d_kv_cache_manager_tpu.api.http_service import serve  # noqa: E402
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import (  # noqa: E402
+    Indexer,
+    IndexerConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (  # noqa: E402,E501
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (  # noqa: E402
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (  # noqa: E402
+    Message,
+    Pool,
+    PoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.models.kv_cache_pool import (  # noqa: E402
+    KVCachePool,
+    KVCachePoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.native.engine import JobStatus  # noqa: E402
+from llm_d_kv_cache_manager_tpu.offload.host_tier import (  # noqa: E402
+    HostTierCache,
+)
+from llm_d_kv_cache_manager_tpu.offload.spec import (  # noqa: E402
+    TPUOffloadConnector,
+    TPUOffloadSpec,
+)
+from llm_d_kv_cache_manager_tpu.offload.worker import (  # noqa: E402
+    group_blocks_per_file,
+    host_dtype,
+)
+from llm_d_kv_cache_manager_tpu.tiering import (  # noqa: E402
+    DemotionConfig,
+    PolicyEngine,
+    StagedDemotionTarget,
+    pool_event_sink,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (  # noqa: E402
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (  # noqa: E402
+    Encoding,
+)
+
+MODEL = "test-model"
+BLOCK_SIZE = 4  # indexer-side tokens per block
+
+
+class WordTokenizer:
+    """Deterministic whitespace tokenizer: 'tN' -> N."""
+
+    def type(self) -> str:
+        return "word"
+
+    def encode(self, prompt, model_name, add_special_tokens=True):
+        tokens, offsets, pos = [], [], 0
+        for word in prompt.split(" "):
+            tokens.append(int(word[1:]))
+            offsets.append((pos, pos + len(word)))
+            pos += len(word) + 1
+        return Encoding(tokens, offsets)
+
+
+def post(base, path, obj):
+    request = urllib.request.Request(
+        base + path,
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(request, timeout=30) as response:
+        return json.load(response)
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as response:
+        return json.load(response)
+
+
+def get_text(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as response:
+        return response.read().decode()
+
+
+def main() -> None:  # noqa: PLR0915 — one linear smoke story
+    storage_root = tempfile.mkdtemp(prefix="kvtpu-offload-smoke-")
+
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE
+            ),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                workers=2, model_name=MODEL
+            ),
+        ),
+        tokenizer=WordTokenizer(),
+    )
+    indexer.run()
+    engine = PolicyEngine(ledger=indexer.cache_stats)
+    indexer.set_policy_engine(engine)
+    event_pool = Pool(
+        indexer.kv_block_index,
+        indexer.token_processor,
+        PoolConfig(concurrency=2),
+    )
+    event_pool.start()
+
+    # --- the real data plane: pool + staged connector + policy feeds ---
+    pool_config = KVCachePoolConfig(
+        num_layers=2,
+        num_blocks=64,
+        block_size=8,
+        num_kv_heads=2,
+        head_dim=16,
+        dtype="bfloat16",
+    )
+    pool = KVCachePool(pool_config)
+    spec = TPUOffloadSpec(
+        shared_storage_path=storage_root,
+        model_name=MODEL,
+        device_block_size=8,
+        offloaded_block_size=16,
+        threads_per_chip=2,
+        host_cache_bytes=0,
+        staging_lanes=2,
+    )
+    connector = TPUOffloadConnector(spec, pool, policy_engine=engine)
+    assert connector.staging is not None, "staging knob did not engage"
+
+    # 1. store -> evict -> load round trip through the staging engine.
+    rng = np.random.default_rng(7)
+    block_ids = [3, 4, 7, 9]
+    originals = {}
+    for block_id in block_ids:
+        data = rng.standard_normal(
+            (
+                pool_config.num_layers,
+                2,
+                pool_config.block_size,
+                pool_config.num_kv_heads,
+                pool_config.head_dim,
+            )
+        ).astype(host_dtype(pool_config.dtype))
+        pool.write_block(block_id, data)
+        originals[block_id] = data
+    file_hashes = [0xA1, 0xA2]
+    groups = group_blocks_per_file(file_hashes, block_ids, 2)
+    connector.store_handler.transfer_async(1, groups)
+    assert connector.store_handler.wait(1) == JobStatus.SUCCEEDED
+    for file_hash in file_hashes:
+        path = connector.file_mapper.get_file_name(file_hash)
+        assert os.path.exists(path), f"missing block file {path}"
+
+    zero = np.zeros_like(next(iter(originals.values())))
+    for block_id in block_ids:  # "evict": the pool forgets the bytes
+        pool.write_block(block_id, zero)
+    connector.load_handler.transfer_async(
+        2, group_blocks_per_file(file_hashes, block_ids, 2)
+    )
+    assert connector.load_handler.wait(2) == JobStatus.SUCCEEDED
+    restored = pool.gather_to_host(block_ids)
+    for i, block_id in enumerate(block_ids):
+        np.testing.assert_array_equal(restored[:, i], originals[block_id])
+    print("store -> evict -> load round trip: bytes bit-identical")
+
+    # Both estimator directions observed REAL transfers.
+    advisor_stats = engine.advisor.stats()
+    assert advisor_stats["rtt"]["observations"] >= 1, advisor_stats
+    assert advisor_stats["rtt_store"]["observations"] >= 1, advisor_stats
+
+    # 2. the index side: seed a chain on pod-1 at hbm, teach the feed.
+    tokens = list(range(1, 33))  # 8 blocks of 4
+    n_blocks = len(tokens) // BLOCK_SIZE
+    prompt = " ".join(f"t{t}" for t in tokens)
+    engine_hashes = [0x300 + i for i in range(n_blocks)]
+    batch = EventBatch(
+        ts=1.0,
+        events=[
+            BlockStored(
+                block_hashes=list(engine_hashes),
+                parent_block_hash=None,
+                token_ids=tokens,
+                block_size=BLOCK_SIZE,
+                medium="hbm",
+            )
+        ],
+    )
+    event_pool.add_task(
+        Message(
+            topic=f"kv@pod-1@{MODEL}",
+            payload=batch.encode(),
+            pod_identifier="pod-1",
+            model_name=MODEL,
+        )
+    )
+    event_pool.drain()
+
+    server = serve(indexer, host="127.0.0.1", port=0, tiering=engine)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    scores = post(
+        base, "/score_completions", {"prompt": prompt, "model": MODEL}
+    )
+    assert scores.get("pod-1") == n_blocks, scores
+
+    # 3. demotion cycles MOVE BYTES, and index tier + score follow.
+    demo_ids = [11, 12]
+    for block_id in demo_ids:
+        pool.write_block(
+            block_id,
+            rng.standard_normal(zero.shape).astype(zero.dtype),
+        )
+    expected_group = pool.gather_block_major(demo_ids)
+    host_cache = HostTierCache(1 << 22)
+    group_key = 0xFACE
+    target = StagedDemotionTarget(
+        capacity_bytes=64 * pool.block_nbytes,
+        pool=pool,
+        file_mapper=connector.file_mapper,
+        host_cache=host_cache,
+        event_sink=pool_event_sink(event_pool, "pod-1", MODEL),
+        feed=engine.feed,
+        store_rtt_observer=engine.advisor.observe_store,
+    )
+    target.register_pool_group(
+        group_key,
+        block_ids=demo_ids,
+        engine_hashes=engine_hashes,
+        token_ids=tokens,
+        block_size=BLOCK_SIZE,
+        now=time.monotonic() - 600,
+    )
+    worker = engine.start_demotion(
+        target,
+        DemotionConfig(
+            demote_host_idle_s=0.0,
+            demote_storage_idle_s=0.0,
+            require_prediction=False,
+        ),
+        start=False,
+    )
+
+    # Rung 1: hbm -> host — bytes readable from the host tier.
+    assert worker.run_cycle() == 1, "expected the hbm->host move"
+    cached = host_cache.get(group_key)
+    assert cached is not None, "demotion advertised host without bytes"
+    np.testing.assert_array_equal(cached, expected_group)
+    event_pool.drain()
+    scores = post(
+        base, "/score_completions", {"prompt": prompt, "model": MODEL}
+    )
+    assert abs(scores["pod-1"] - 0.8 * n_blocks) < 1e-9, scores
+    print("demotion hbm -> host: bytes in host tier, score 1.0 -> 0.8")
+
+    # Rung 2: host -> shared_storage — bytes readable from the file.
+    assert worker.run_cycle() == 1, "expected the host->storage move"
+    path = connector.file_mapper.get_file_name(group_key)
+    with open(path, "rb") as handle:
+        on_disk = np.frombuffer(
+            handle.read(), dtype=expected_group.dtype
+        ).reshape(expected_group.shape)
+    np.testing.assert_array_equal(on_disk, expected_group)
+    assert host_cache.get(group_key) is None, "host entry must retire"
+    event_pool.drain()
+    scores = post(
+        base, "/score_completions", {"prompt": prompt, "model": MODEL}
+    )
+    assert abs(scores["pod-1"] - 0.5 * n_blocks) < 1e-9, scores
+    print(
+        "demotion host -> shared_storage: bytes on disk, score 0.8 -> 0.5"
+    )
+
+    # 4. measured RTT visible in /debug/tiering; gauge on /metrics.
+    status = get(base, "/debug/tiering")
+    advisor_block = status["advisor"]
+    assert advisor_block["rtt"]["observations"] >= 1, advisor_block
+    assert advisor_block["rtt"]["per_byte_s"] is not None, advisor_block
+    assert advisor_block["rtt_store"]["observations"] >= 2, advisor_block
+    demotion_block = status["demotion"][0]
+    assert demotion_block["moves"] == 2, demotion_block
+
+    text = get_text(base, "/metrics")
+    assert "kvtpu_tiering_writeback_rtt_seconds" in text
+    assert "kvtpu_offload_staging_lane_waits_total" in text
+    assert 'kvtpu_offload_bytes_total{direction="store"}' in text
+    assert 'kvtpu_tiering_demotions_total{transition="host_to_storage"}' in text
+
+    server.shutdown()
+    engine.close()
+    connector.close()
+    event_pool.shutdown()
+    indexer.shutdown()
+    print("offload smoke completed successfully")
+
+
+if __name__ == "__main__":
+    main()

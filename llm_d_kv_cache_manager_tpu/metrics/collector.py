@@ -207,6 +207,14 @@ class KVCacheMetrics:
             ("direction", "status"),
             registry=self.registry,
         )
+        self.offload_staging_lane_waits = Counter(
+            f"{_NAMESPACE}_offload_staging_lane_waits_total",
+            "Staged transfers that had to wait for a free per-chip "
+            "staging lane (lane-saturation backpressure; climbing "
+            "value = raise OFFLOAD_STAGING_LANES or the engine is "
+            "wedged).",
+            registry=self.registry,
+        )
         # Cache-efficiency analytics (analytics/ledger.py): per-request
         # hit attribution on the scoring read path.  At
         # CACHESTATS_SAMPLE_RATE < 1 these are an unbiased sample of
@@ -297,6 +305,12 @@ class KVCacheMetrics:
             f"{_NAMESPACE}_tiering_readback_rtt_seconds",
             "EWMA of observed offload load-job latency (submit to "
             "harvest) feeding the compute-or-load advisor.",
+            registry=self.registry,
+        )
+        self.tiering_writeback_rtt = Gauge(
+            f"{_NAMESPACE}_tiering_writeback_rtt_seconds",
+            "EWMA of observed offload store-job latency (submit to "
+            "harvest) feeding the advisor's write-side cost model.",
             registry=self.registry,
         )
         self.tiering_snapshot_age = Gauge(

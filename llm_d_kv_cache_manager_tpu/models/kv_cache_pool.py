@@ -48,6 +48,15 @@ _scatter_donated = jax.jit(
 )
 
 
+@jax.jit
+def _gather_block_major(kv: jax.Array, block_ids: jax.Array) -> jax.Array:
+    """Gather + layer-major -> block-major transpose ON DEVICE: the
+    staging engine's file layout is ``[n, L, 2, bs, h, d]``, and doing
+    the moveaxis in XLA means the host-bound DMA already carries file
+    bytes (no host-side ``np.ascontiguousarray`` re-layout copy)."""
+    return jnp.moveaxis(jnp.take(kv, block_ids, axis=1), 1, 0)
+
+
 def supports_pinned_host(device: Optional[jax.Device] = None) -> bool:
     """Whether the backend exposes a pinned_host memory space (TPU yes,
     CPU tests typically yes on recent jaxlib, but never assumed)."""
@@ -86,6 +95,13 @@ class KVCachePool:
         )
 
     @property
+    def pinned_host(self) -> bool:
+        """Whether this pool's device exposes a pinned_host memory
+        space (the staging engine's fast-path gate; flips off after a
+        failed transfer so the probe is never retried per job)."""
+        return self._pinned_host
+
+    @property
     def block_nbytes(self) -> int:
         """Bytes of one block across all layers (the offload unit)."""
         c = self.config
@@ -116,6 +132,47 @@ class KVCachePool:
             except Exception:
                 self._pinned_host = False
         return np.asarray(jax.device_get(gathered))
+
+    def stage_gather_pinned(self, block_ids: Sequence[int]) -> jax.Array:
+        """Device gather+transpose, then an ASYNC DMA into pinned_host.
+
+        Returns the pinned ``[n, L, 2, bs, h, d]`` array without
+        forcing it, so the caller can overlap this slot's DMA with the
+        previous slot's file I/O (the staging engine's double-buffered
+        pipeline) and force only at submit time.  Raises when the
+        backend has no pinned_host space — callers gate on
+        :attr:`pinned_host` and fall back to :meth:`gather_block_major`.
+        """
+        if not self._pinned_host:
+            raise RuntimeError("device exposes no pinned_host memory space")
+        ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
+        gathered = _gather_block_major(self.kv, ids)
+        return jax.device_put(
+            gathered, jax.memory.TransferToMemoryKind("pinned_host")
+        )
+
+    def gather_block_major(self, block_ids: Sequence[int]) -> np.ndarray:
+        """Block-major host gather ``[n, L, 2, bs, h, d]`` — the file
+        byte layout, transposed on device (one copy fewer than
+        :meth:`gather_to_host` + host moveaxis).  Pinned DMA when the
+        backend supports it, plain transfer otherwise."""
+        ids = jnp.asarray(np.asarray(block_ids, dtype=np.int32))
+        gathered = _gather_block_major(self.kv, ids)
+        if self._pinned_host:
+            try:
+                gathered = jax.device_put(
+                    gathered, jax.memory.TransferToMemoryKind("pinned_host")
+                )
+            except Exception:
+                self._pinned_host = False
+        return np.asarray(jax.device_get(gathered))
+
+    def scatter_block_major(
+        self, block_ids: Sequence[int], group: np.ndarray
+    ) -> None:
+        """Scatter a block-major ``[n, L, 2, bs, h, d]`` host group (the
+        staging engine's slot/file layout) into the pool."""
+        self.scatter_from_host(block_ids, np.moveaxis(group, 0, 1))
 
     def scatter_from_host(
         self,

@@ -71,6 +71,37 @@ def native_hash_chain(
     return [int(v) for v in out[:written]]
 
 
+def store_file(
+    path: str, buffer: np.ndarray, skip_existing: bool = True
+) -> bool:
+    """Synchronous atomic (tmp+rename) store of one host buffer — the
+    Python engine's per-file primitive, exposed for callers that need
+    a harvest-free write on their own thread (the staged demotion
+    target: sharing the async engine's completion stream with the
+    connector's ``get_finished`` poll would race the harvest)."""
+    try:
+        if skip_existing:
+            # Dedupe only when the resident file covers at least our
+            # bytes; a smaller file is a partial (head) group and is
+            # upgraded by rewriting (file = head-k blocks of a
+            # group).  If the stat/touch races a sweeper delete,
+            # fall through and write the bytes we hold.
+            try:
+                if os.path.getsize(path) >= buffer.nbytes:
+                    os.utime(path)
+                    return True
+            except OSError:
+                pass
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(buffer.tobytes())
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False
+
+
 class _PythonEngine:
     """Fallback job engine: ThreadPoolExecutor + Python file I/O."""
 
@@ -83,29 +114,7 @@ class _PythonEngine:
         )
         self._jobs: Dict[int, List[Future]] = {}
 
-    @staticmethod
-    def _store_one(path: str, buffer: np.ndarray, skip_existing: bool) -> bool:
-        try:
-            if skip_existing:
-                # Dedupe only when the resident file covers at least our
-                # bytes; a smaller file is a partial (head) group and is
-                # upgraded by rewriting (file = head-k blocks of a
-                # group).  If the stat/touch races a sweeper delete,
-                # fall through and write the bytes we hold.
-                try:
-                    if os.path.getsize(path) >= buffer.nbytes:
-                        os.utime(path)
-                        return True
-                except OSError:
-                    pass
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-            with open(tmp, "wb") as f:
-                f.write(buffer.tobytes())
-            os.replace(tmp, path)
-            return True
-        except OSError:
-            return False
+    _store_one = staticmethod(store_file)
 
     @staticmethod
     def _load_one(path: str, buffer: np.ndarray) -> bool:

@@ -6,6 +6,11 @@ from llm_d_kv_cache_manager_tpu.offload.spec import (  # noqa: F401
     TPUOffloadConnector,
     TPUOffloadSpec,
 )
+from llm_d_kv_cache_manager_tpu.offload.staging_engine import (  # noqa: F401
+    StagingConfig,
+    StagingEngine,
+    StagingSaturated,
+)
 from llm_d_kv_cache_manager_tpu.offload.worker import (  # noqa: F401
     DeviceToStorageHandler,
     StorageToDeviceHandler,

@@ -13,6 +13,7 @@ device mesh instead of torch.distributed world info.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -23,11 +24,37 @@ from llm_d_kv_cache_manager_tpu.offload.manager import (
     SharedStorageOffloadManager,
 )
 from llm_d_kv_cache_manager_tpu.offload.staging import StagingBudget
+from llm_d_kv_cache_manager_tpu.offload.staging_engine import (
+    DEFAULT_LANE_WAIT_S,
+    DEFAULT_SLOTS_PER_LANE,
+    StagingConfig,
+    StagingEngine,
+)
 from llm_d_kv_cache_manager_tpu.offload.worker import (
     DeviceToStorageHandler,
     StorageToDeviceHandler,
     StoreEventSink,
 )
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
 
 
 @dataclass
@@ -51,6 +78,15 @@ class TPUOffloadSpec:
     # llmd_fs_backend/worker.py:191-216); submissions block until
     # completions free room.
     max_staging_memory_gb: float = 150.0
+    # Per-chip staging-lane pipeline (offload/staging_engine.py,
+    # docs/host-offload.md).  0 disables (the one-shot gather path, the
+    # parity oracle); -1 resolves from OFFLOAD_STAGING_LANES (default
+    # 0).  slots: pipeline depth per lane (-1 = OFFLOAD_STAGING_SLOTS,
+    # default 2 = double buffering); lane_wait_s: saturation watchdog
+    # (-1 = OFFLOAD_STAGING_WATCHDOG_S, default 60).
+    staging_lanes: int = -1
+    staging_slots: int = -1
+    staging_lane_wait_s: float = -1.0
     dtype: str = "bfloat16"
     tp_size: int = 1
     pp_size: int = 1
@@ -63,6 +99,16 @@ class TPUOffloadSpec:
                 "offloaded_block_size must be a multiple of "
                 f"device_block_size ({self.offloaded_block_size} % "
                 f"{self.device_block_size} != 0)"
+            )
+        if self.staging_lanes < 0:
+            self.staging_lanes = _env_int("OFFLOAD_STAGING_LANES", 0)
+        if self.staging_slots < 0:
+            self.staging_slots = _env_int(
+                "OFFLOAD_STAGING_SLOTS", DEFAULT_SLOTS_PER_LANE
+            )
+        if self.staging_lane_wait_s < 0:
+            self.staging_lane_wait_s = _env_float(
+                "OFFLOAD_STAGING_WATCHDOG_S", DEFAULT_LANE_WAIT_S
             )
 
     @property
@@ -128,15 +174,33 @@ class TPUOffloadConnector:
         self.policy_engine = policy_engine
         host_eviction_policy = None
         rtt_observer = None
+        store_rtt_observer = None
         if policy_engine is not None:
             host_eviction_policy = policy_engine.eviction_policy(
                 backend="host_tier"
             )
             rtt_observer = policy_engine.advisor.observe_load
+            store_rtt_observer = policy_engine.advisor.observe_store
             if policy_engine.advisor.config.bytes_per_block <= 0:
                 policy_engine.advisor.config.bytes_per_block = (
                     pool.block_nbytes
                 )
+        # Per-chip staging lanes (docs/host-offload.md): pinned-slot
+        # pipeline overlapping device DMA with file I/O.  Off by
+        # default — the one-shot path is the parity oracle.
+        self.staging: Optional[StagingEngine] = None
+        if spec.staging_lanes > 0:
+            self.staging = StagingEngine(
+                pool,
+                self.engine,
+                self.file_mapper,
+                spec.blocks_per_file,
+                StagingConfig(
+                    lanes_per_chip=spec.staging_lanes,
+                    slots_per_lane=spec.staging_slots,
+                    lane_wait_s=spec.staging_lane_wait_s,
+                ),
+            )
         self.host_cache = None
         if spec.host_cache_bytes > 0:
             from llm_d_kv_cache_manager_tpu.offload.host_tier import (
@@ -154,6 +218,8 @@ class TPUOffloadConnector:
             event_sink=event_sink,
             host_cache=self.host_cache,
             staging_budget=self.staging_budget,
+            staging=self.staging,
+            rtt_observer=store_rtt_observer,
         )
         self.load_handler = StorageToDeviceHandler(
             pool,
@@ -162,6 +228,7 @@ class TPUOffloadConnector:
             host_cache=self.host_cache,
             staging_budget=self.staging_budget,
             rtt_observer=rtt_observer,
+            staging=self.staging,
         )
 
     def get_manager(self) -> SharedStorageOffloadManager:
@@ -175,9 +242,21 @@ class TPUOffloadConnector:
     def get_finished(self):
         """Poll the shared engine once and route each completion to the
         handler that owns the job (store-event emission / load scatter
-        happen here)."""
-        routed = []
+        happen here).  With staging enabled, engine completions are
+        offered to the staging engine first — its sub-jobs never
+        surface raw; the PARENT job id surfaces once its last file
+        lands."""
+        completions = []
         for job_id, status in self.engine.get_finished():
+            if self.staging is not None and self.staging.claim(
+                job_id, status
+            ):
+                continue  # a staged sub-job; parent surfaces below
+            completions.append((job_id, status))
+        if self.staging is not None:
+            completions.extend(self.staging.pop_ready())
+        routed = []
+        for job_id, status in completions:
             for handler in (self.store_handler, self.load_handler):
                 if handler.owns(job_id):
                     status = handler.on_finished(job_id, status)

@@ -65,6 +65,10 @@ FileBlockGroup = Tuple[int, Sequence[int]]
 # advertise the new tier in its KVEvents stream.
 StoreEventSink = Callable[[List[int], str], None]
 
+# Write-side cost feed: (file_nbytes, io_seconds, device_seconds) per
+# successful store job — the advisor's observe_store signature.
+StoreRttObserver = Callable[[int, float, Optional[float]], None]
+
 SHARED_STORAGE_MEDIUM = "shared_storage"
 HOST_MEDIUM = "host"
 
@@ -119,6 +123,7 @@ class _HandlerBase:
         engine: OffloadEngine,
         file_mapper: FileMapper,
         staging_budget=None,
+        staging=None,
     ) -> None:
         self.pool = pool
         self.engine = engine
@@ -128,6 +133,12 @@ class _HandlerBase:
         # completion, success or not.
         self._budget = staging_budget
         self._budget_bytes: Dict[int, int] = {}
+        # Optional per-chip staging engine (offload/staging_engine.py):
+        # when present, transfers pipeline through pinned lane slots
+        # instead of the one-shot gather, and jobs in _staged complete
+        # through the staging engine rather than raw engine ids.
+        self._staging = staging
+        self._staged: set = set()
         # Sampled per-job traces: job_id -> (trace, io-start stamp).
         # Submit-to-harvest, same single-submitter discipline as the
         # other per-job dicts here.
@@ -192,7 +203,17 @@ class _HandlerBase:
         """Completion hook; returns the (possibly updated) status."""
         raise NotImplementedError
 
+    def _staging_stats(self, job_id: int) -> Optional[dict]:
+        """Measured splits of a completing staged job (pops the staging
+        record); None for one-shot jobs."""
+        if self._staging is None or job_id not in self._staged:
+            return None
+        self._staged.discard(job_id)
+        return self._staging.job_stats(job_id)
+
     def wait(self, job_id: int) -> JobStatus:
+        if self._staging is not None and job_id in self._staged:
+            return self.on_finished(job_id, self._staging.wait(job_id))
         return self.on_finished(job_id, self.engine.wait(job_id))
 
 
@@ -210,12 +231,22 @@ class DeviceToStorageHandler(_HandlerBase):
         event_sink: Optional[StoreEventSink] = None,
         host_cache=None,
         staging_budget=None,
+        staging=None,
+        rtt_observer: Optional[StoreRttObserver] = None,
     ):
-        super().__init__(*args, staging_budget=staging_budget)
+        super().__init__(*args, staging_budget=staging_budget,
+                         staging=staging)
         self._event_sink = event_sink
         self._host_cache = host_cache
-        # job_id -> (file hashes, payload bytes) until completion.
-        self._job_hashes: Dict[int, Tuple[List[int], int]] = {}
+        # Write-side advisor feed (tiering/advisor.py observe_store):
+        # called with (file bytes, io seconds, device seconds) on every
+        # successful store so demotion cost is priced from measurement.
+        self._rtt_observer = rtt_observer
+        # job_id -> (file hashes, payload bytes, device-transfer
+        # seconds) until completion.
+        self._job_hashes: Dict[
+            int, Tuple[List[int], int, Optional[float]]
+        ] = {}
 
     def transfer_async(
         self, job_id: int, groups: Sequence[FileBlockGroup]
@@ -228,6 +259,10 @@ class DeviceToStorageHandler(_HandlerBase):
         self._budget_acquire(
             job_id, len(all_ids) * self.pool.block_nbytes
         )
+        if self._staging is not None:
+            self._transfer_async_staged(job_id, groups, job_trace)
+            return
+        device_t0 = time.perf_counter()
         with use_trace(job_trace), obs_span("offload.stage") as stage:
             # One gather + one DMA for the whole job.
             host = self.pool.gather_to_host(all_ids)  # [L, n, 2, bs, h, d]
@@ -244,6 +279,7 @@ class DeviceToStorageHandler(_HandlerBase):
                     np.ascontiguousarray(np.moveaxis(chunk, 1, 0))
                 )
                 cursor += len(ids)
+            device_s = time.perf_counter() - device_t0
             if self._host_cache is not None:
                 admitted = [
                     file_hash
@@ -257,9 +293,39 @@ class DeviceToStorageHandler(_HandlerBase):
         self._job_hashes[job_id] = (
             [h for h, _ in groups],
             sum(buffer.nbytes for buffer in buffers),
+            device_s,
         )
         self._trace_io_start(job_id, job_trace)
         self.engine.store(job_id, paths, buffers, skip_existing=True)
+
+    def _transfer_async_staged(
+        self, job_id: int, groups: Sequence[FileBlockGroup], job_trace
+    ) -> None:
+        """Staging-engine path: per-group pinned-slot pipeline; the
+        host-tier admission hook copies (slots are reused)."""
+        admitted: List[int] = []
+
+        def on_group(file_hash: int, buffer: np.ndarray) -> None:
+            if self._host_cache is not None and self._host_cache.put(
+                file_hash, buffer.copy()
+            ):
+                admitted.append(file_hash)
+
+        # Pending entry BEFORE submission so a parent that completes
+        # mid-pipeline (every sub waited out) still routes here.
+        self._job_hashes[job_id] = (
+            [h for h, _ in groups],
+            sum(len(ids) for _, ids in groups) * self.pool.block_nbytes,
+            None,  # device split measured by the staging engine
+        )
+        self._staged.add(job_id)
+        self._trace_io_start(job_id, job_trace)
+        with use_trace(job_trace), obs_span("offload.stage") as stage:
+            stage.set_attr("files", len(groups))
+            stage.set_attr("staged", True)
+            self._staging.store(job_id, groups, on_group=on_group)
+        if admitted and self._event_sink is not None:
+            self._event_sink(admitted, HOST_MEDIUM)
 
     def owns(self, job_id: int) -> bool:
         return job_id in self._job_hashes
@@ -267,8 +333,11 @@ class DeviceToStorageHandler(_HandlerBase):
     def on_finished(self, job_id: int, status: JobStatus) -> JobStatus:
         self._budget_release(job_id)
         self._trace_finish(job_id, status)
-        self._io_elapsed(job_id)  # drop the stamp (store side unused)
-        hashes, nbytes = self._job_hashes.pop(job_id, (None, 0))
+        io_seconds = self._io_elapsed(job_id)
+        staged = self._staging_stats(job_id)
+        hashes, nbytes, device_s = self._job_hashes.pop(
+            job_id, (None, 0, None)
+        )
         if hashes is None:
             # A completion this handler never submitted (or one already
             # harvested) points at connector routing bugs — the store
@@ -282,6 +351,24 @@ class DeviceToStorageHandler(_HandlerBase):
         METRICS.offload_jobs.labels("store", status.name.lower()).inc()
         if status != JobStatus.SUCCEEDED:
             return status
+        if staged is not None:
+            # The staging engine measured the real splits: the file
+            # window (first submit -> last completion) and the summed
+            # gather+DMA time — tighter than submit->harvest, which
+            # also counts idle-until-poll slack.
+            io_seconds = staged["io_s"] or io_seconds
+            device_s = staged["device_s"]
+        if (
+            self._rtt_observer is not None
+            and io_seconds is not None
+            and nbytes > 0
+        ):
+            # Write-side cost feed: demotion pricing needs the store
+            # path measured, not mirrored from readback.
+            try:
+                self._rtt_observer(nbytes, io_seconds, device_s)
+            except Exception:  # noqa: BLE001 — advisory feed only
+                logger.exception("store rtt observer failed")
         # Counted on success only, symmetric with the load path (bytes
         # deduped by skip_existing still transit the gather+DMA).
         METRICS.offload_bytes.labels("store").inc(nbytes)
@@ -298,9 +385,10 @@ class StorageToDeviceHandler(_HandlerBase):
 
     def __init__(
         self, *args, host_cache=None, staging_budget=None,
-        rtt_observer=None,
+        rtt_observer=None, staging=None,
     ):
-        super().__init__(*args, staging_budget=staging_budget)
+        super().__init__(*args, staging_budget=staging_budget,
+                         staging=staging)
         self._host_cache = host_cache
         # Compute-or-load feed (tiering/advisor.py): called with
         # (payload bytes, submit->harvest seconds) on every successful
@@ -319,6 +407,9 @@ class StorageToDeviceHandler(_HandlerBase):
         n_blocks = sum(len(ids) for _, ids in groups)
         job_trace = self._trace_submit("offload.load", job_id, n_blocks)
         self._budget_acquire(job_id, n_blocks * self.pool.block_nbytes)
+        if self._staging is not None:
+            self._transfer_async_staged(job_id, groups, job_trace)
+            return
         with use_trace(job_trace), obs_span("offload.stage") as stage:
             paths: List[str] = []
             buffers: List[np.ndarray] = []
@@ -362,6 +453,51 @@ class StorageToDeviceHandler(_HandlerBase):
         # Zero-file jobs still register so get_finished reports them.
         self.engine.load(job_id, paths, file_buffers)
 
+    def _transfer_async_staged(
+        self, job_id: int, groups: Sequence[FileBlockGroup], job_trace
+    ) -> None:
+        """Staging-engine path: host-tier hits scatter immediately,
+        file-backed groups pipeline through the lane slots (the
+        staging engine scatters each as its read lands)."""
+        file_groups: List[FileBlockGroup] = []
+        host_hits = 0
+        with use_trace(job_trace), obs_span("offload.stage") as stage:
+            for file_hash, ids in groups:
+                cached = (
+                    self._host_cache.get(file_hash)
+                    if self._host_cache is not None
+                    else None
+                )
+                if cached is not None and cached.shape[0] >= len(ids):
+                    # Host-tier hit: head blocks of the cached group
+                    # (block-major layout invariant), device-bound now
+                    # — serialized with the staging engine's
+                    # harvest-time scatters.
+                    self._staging.scatter_block_major(
+                        list(ids), cached[: len(ids)]
+                    )
+                    host_hits += 1
+                else:
+                    file_groups.append((file_hash, ids))
+            stage.set_attr("files", len(file_groups))
+            stage.set_attr("host_tier_hits", host_hits)
+            stage.set_attr("staged", True)
+        file_nbytes = (
+            sum(len(ids) for _, ids in file_groups)
+            * self.pool.block_nbytes
+        )
+        # Buffers live in the staging engine's slots; the pending entry
+        # carries an empty buffer list so on_finished skips the
+        # one-shot concatenate+scatter (already done per group).
+        self._pending[job_id] = (
+            [i for _, ids in groups for i in ids],
+            [],
+            file_nbytes,
+        )
+        self._staged.add(job_id)
+        self._trace_io_start(job_id, job_trace)
+        self._staging.load(job_id, file_groups)
+
     def owns(self, job_id: int) -> bool:
         return job_id in self._pending
 
@@ -369,6 +505,7 @@ class StorageToDeviceHandler(_HandlerBase):
         self._budget_release(job_id)
         self._trace_finish(job_id, status)
         io_seconds = self._io_elapsed(job_id)
+        staged = self._staging_stats(job_id)
         pending = self._pending.pop(job_id, None)
         METRICS.offload_jobs.labels("load", status.name.lower()).inc()
         if pending is None:
@@ -385,10 +522,15 @@ class StorageToDeviceHandler(_HandlerBase):
         if status != JobStatus.SUCCEEDED:
             return status
         block_ids, buffers, file_nbytes = pending
-        host = np.concatenate([np.moveaxis(b, 0, 1) for b in buffers], axis=1)
         METRICS.offload_bytes.labels("load").inc(
-            sum(buffer.nbytes for buffer in buffers)
+            len(block_ids) * self.pool.block_nbytes
+            if staged is not None
+            else sum(buffer.nbytes for buffer in buffers)
         )
+        if staged is not None:
+            # The staging engine measured the file window directly —
+            # tighter than submit->harvest (no idle-until-poll slack).
+            io_seconds = staged["io_s"] or io_seconds
         if (
             self._rtt_observer is not None
             and io_seconds is not None
@@ -401,5 +543,10 @@ class StorageToDeviceHandler(_HandlerBase):
                 self._rtt_observer(file_nbytes, io_seconds)
             except Exception:  # noqa: BLE001 — advisory feed only
                 logger.exception("rtt observer failed")
-        self.pool.scatter_from_host(block_ids, host)
+        if staged is None:
+            host = np.concatenate(
+                [np.moveaxis(b, 0, 1) for b in buffers], axis=1
+            )
+            self.pool.scatter_from_host(block_ids, host)
+        # Staged groups were scattered as each file read landed.
         return status

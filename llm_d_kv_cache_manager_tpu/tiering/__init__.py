@@ -53,6 +53,9 @@ from llm_d_kv_cache_manager_tpu.tiering.policy_feed import (
     PolicySnapshot,
     ReusePrediction,
 )
+from llm_d_kv_cache_manager_tpu.tiering.staged_target import (
+    StagedDemotionTarget,
+)
 
 __all__ = [
     "Advice",
@@ -69,6 +72,7 @@ __all__ = [
     "PredictiveEvictionPolicy",
     "ReusePrediction",
     "RttEstimator",
+    "StagedDemotionTarget",
     "TieringConfig",
     "pool_event_sink",
 ]

@@ -86,12 +86,18 @@ class RttEstimator:
     ``per_byte_s`` is learned from job observations.  Each observation
     attributes ``max(seconds - floor_s, 0)`` to the bytes moved, so a
     measured floor keeps small transfers from inflating the slope.
+
+    ``gauge`` is the Prometheus gauge the job-latency EWMA lands on —
+    the load-side estimator publishes ``tiering_readback_rtt_seconds``,
+    the store-side one ``tiering_writeback_rtt_seconds`` (None skips
+    publication, for auxiliary estimators).
     """
 
-    def __init__(self, floor_s: float = 0.0) -> None:
+    def __init__(self, floor_s: float = 0.0, gauge=None) -> None:
         self._lock = lockorder.tracked(
             threading.Lock(), "RttEstimator._lock"
         )
+        self._gauge = gauge
         self._floor_s = floor_s  # guarded-by: _lock
         self._per_byte_s: Optional[float] = None  # guarded-by: _lock
         self._ewma_job_s: Optional[float] = None  # guarded-by: _lock
@@ -122,7 +128,8 @@ class RttEstimator:
             )
             self._observations += 1
             job_s = self._ewma_job_s
-        METRICS.tiering_readback_rtt.set(job_s)
+        if self._gauge is not None:
+            self._gauge.set(job_s)
 
     def params(self):
         """(floor_s, per_byte_s) under one lock hit, or None when the
@@ -189,7 +196,18 @@ class ComputeOrLoadAdvisor:
 
     def __init__(self, config: Optional[AdvisorConfig] = None) -> None:
         self.config = config or AdvisorConfig()
-        self.rtt = RttEstimator(floor_s=self.config.rtt_floor_s)
+        self.rtt = RttEstimator(
+            floor_s=self.config.rtt_floor_s,
+            gauge=METRICS.tiering_readback_rtt,
+        )
+        # Write-side cost model: fed by the offload store path
+        # (device->host->file), so demotion is priced from measured
+        # transfers, not the readback model's mirror image.
+        self.rtt_store = RttEstimator(gauge=METRICS.tiering_writeback_rtt)
+        # EWMA of the store path's device-transfer (gather + DMA)
+        # per-byte cost — the half of a demotion the file write hides.
+        self._store_device_per_byte: Optional[float] = None
+        self._store_device_observations = 0
         self._prefill_rate: Optional[float] = (
             self.config.prefill_tokens_per_s
             if self.config.prefill_tokens_per_s > 0
@@ -207,6 +225,39 @@ class ComputeOrLoadAdvisor:
 
     def observe_load(self, nbytes: int, seconds: float) -> None:
         self.rtt.observe(nbytes, seconds)
+
+    def observe_store(
+        self,
+        nbytes: int,
+        io_seconds: float,
+        device_seconds: Optional[float] = None,
+    ) -> None:
+        """Fold one completed store job into the write-side model:
+        ``io_seconds`` is the host->file window (submit to harvest),
+        ``device_seconds`` the device->host half (gather + DMA) when
+        the path measured it (the staging engine and the one-shot
+        handler both do)."""
+        self.rtt_store.observe(nbytes, io_seconds)
+        if device_seconds is None or device_seconds <= 0 or nbytes <= 0:
+            return
+        sample = device_seconds / nbytes
+        self._store_device_per_byte = (
+            sample
+            if self._store_device_per_byte is None
+            else EWMA_ALPHA * sample
+            + (1.0 - EWMA_ALPHA) * self._store_device_per_byte
+        )
+        self._store_device_observations += 1
+
+    def estimate_store_s(self, nbytes: int) -> Optional[float]:
+        """Predicted seconds to demote ``nbytes`` down one rung
+        (device transfer + file write); None before any store
+        observation."""
+        io_s = self.rtt_store.estimate(nbytes)
+        if io_s is None:
+            return None
+        device = self._store_device_per_byte or 0.0
+        return io_s + nbytes * device
 
     def observe_prefill(self, tokens: int, seconds: float) -> None:
         if tokens <= 0 or seconds <= 0:
@@ -337,6 +388,9 @@ class ComputeOrLoadAdvisor:
     def stats(self) -> dict:
         return {
             "rtt": self.rtt.stats(),
+            "rtt_store": self.rtt_store.stats(),
+            "store_device_per_byte_s": self._store_device_per_byte,
+            "store_device_observations": self._store_device_observations,
             "prefill_tokens_per_s": (
                 None
                 if self._prefill_rate is None
