@@ -266,16 +266,25 @@ def emit_result(full: dict, probe: dict) -> None:
         gap = event_storm.get("gap_storm") or {}
         fairness = event_storm.get("fairness") or {}
         consolidated = event_storm.get("consolidated_pollers_1") or {}
+        poller_scaling = event_storm.get("poller_scaling") or {}
+        replica_local = event_storm.get("replica_local") or {}
+        # Headline bytes are a hard driver budget (the shed loop below
+        # drops whole blocks when the line overflows), so field names
+        # here are terse: stage_us = [decode, apply] µs/msg,
+        # p4_ratio = pollers-4-vs-1 non-inversion guard, ri_scaling =
+        # replica-local 1→3 process scaling.  Full names live in the
+        # results file (detail.event_storm).
         event_storm_compact = {
             "n_pods": event_storm.get("n_pods"),
-            "apply_msgs_per_sec": consolidated.get("apply_msgs_per_sec"),
-            "speedup_vs_threads": event_storm.get(
-                "speedup_vs_thread_baseline"
-            ),
-            "threads": consolidated.get("event_plane_threads"),
+            "apply_sps": consolidated.get("apply_msgs_per_sec"),
+            "stage_us": [
+                consolidated.get("decode_us_per_msg"),
+                consolidated.get("apply_us_per_msg"),
+            ],
+            "p4_ratio": poller_scaling.get("ratio_4_vs_1"),
+            "ri_scaling": replica_local.get("scaling_1_to_3"),
             "fairness_ok": fairness.get("property_holds"),
-            "gap_recovery_s": gap.get("recovery_wall_s"),
-            "staleness_mean_s": gap.get("staleness_mean_s"),
+            "gap_s": gap.get("recovery_wall_s"),
             "consistency": gap.get("post_resync_consistency"),
         }
     replica_scaleout = detail.get("replica_scaleout") or {}
@@ -3435,7 +3444,11 @@ def _storm_throughput_cell(
     same CPUs.  The backlog left in sockets dies with detach (LINGER
     0); the pool's own backlog is drained after the measurement, not
     counted: folding an unbounded drain tail into the rate made the
-    number depend on backlog luck, not capacity."""
+    number depend on backlog luck, not capacity.
+
+    The cell also reports the decode-vs-apply stage split
+    (µs/message inside the window, from ``Pool.stage_stats``) so the
+    bottleneck is attributable straight from the BENCH artifact."""
     from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
 
     pool, _index, _db = _storm_pool(concurrency=4)
@@ -3447,7 +3460,13 @@ def _storm_throughput_cell(
             seen.add(message.pod_identifier)
         pool.add_task(message)
 
-    attach(sink)
+    def sink_batch(messages):
+        with seen_lock:
+            for message in messages:
+                seen.add(message.pod_identifier)
+        pool.add_tasks(messages)
+
+    attach(sink, sink_batch)
     workdir = tempfile.mkdtemp(prefix="kvtpu-storm-pub-")
     proc = None
     detached = False
@@ -3471,12 +3490,14 @@ def _storm_throughput_cell(
         time.sleep(1.0)
         drained_before, _ = _hist_stats(METRICS.kvevents_batch_size)
         dropped_before = counter_total(METRICS.kvevents_dropped)
+        stages_before = pool.stage_stats()
         threads = _event_plane_threads()
 
         t0 = time.perf_counter()
         time.sleep(publish_s)
         elapsed = time.perf_counter() - t0
         drained_after, _ = _hist_stats(METRICS.kvevents_batch_size)
+        stages_after = pool.stage_stats()
         applied = drained_after - drained_before
         # Detach BEFORE draining the pool backlog: the subscription
         # layer's overhead belongs in the window, not in the cleanup.
@@ -3485,12 +3506,27 @@ def _storm_throughput_cell(
         proc.terminate()
         proc.wait(timeout=30)
         pool.drain()
+
+        def stage_us(stage):
+            msgs = (
+                stages_after[f"{stage}_msgs"]
+                - stages_before[f"{stage}_msgs"]
+            )
+            if not msgs:
+                return None
+            seconds = (
+                stages_after[f"{stage}_s"] - stages_before[f"{stage}_s"]
+            )
+            return round(seconds / msgs * 1e6, 1)
+
         return {
             "pods": len(pods),
             "pods_joined": joined,
             "offered_msgs_per_sec": STORM_RATE,
             "applied_msgs_in_window": int(applied),
             "apply_msgs_per_sec": round(applied / elapsed, 1),
+            "decode_us_per_msg": stage_us("decode"),
+            "apply_us_per_msg": stage_us("apply"),
             "dropped": int(
                 counter_total(METRICS.kvevents_dropped) - dropped_before
             ),
@@ -3505,6 +3541,251 @@ def _storm_throughput_cell(
             proc.wait(timeout=10)
         pool.shutdown()
         shutil.rmtree(workdir, ignore_errors=True)
+
+
+# Offered load for the replica-local ingestion cells.  Must exceed the
+# AGGREGATE capacity of the largest replica set so scaling is measured
+# at saturation — with the fast lane a single ingestor can absorb the
+# default storm rate, which would clamp every cell to the offered load
+# and read as "no scaling".
+STORM_RI_RATE = _env_float("KVTPU_BENCH_STORM_RI_RATE", 24000.0)
+
+# One replica-local ingestor as its own PROCESS (own GIL, own poller
+# pool + kvevents pool + index slice — the deployment shape of
+# CLUSTER_LOCAL_INGEST).  Subscribes to its pod slice, reports joins,
+# waits for the go-file, measures applies inside the window, writes a
+# result JSON.  Spawned by _storm_replica_local_cell.
+_STORM_INGESTOR_SRC = r"""
+import json, os, sys, threading, time
+
+spec = json.load(open(sys.argv[1]))
+sys.path.insert(0, spec["repo_root"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import zmq
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import Pool, PoolConfig
+from llm_d_kv_cache_manager_tpu.kvevents.poller import (
+    ChannelConfig,
+    PollerPool,
+    PollerPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+endpoints = spec["endpoints"]
+context = zmq.Context()
+context.set(zmq.MAX_SOCKETS, max(1024, 2 * len(endpoints) + 64))
+index = InMemoryIndex(InMemoryIndexConfig(size=2_000_000))
+db = ChunkedTokenDatabase(
+    TokenProcessorConfig(block_size=int(spec["block_size"]))
+)
+pool = Pool(index, db, PoolConfig(concurrency=int(spec["concurrency"])))
+pool.start()
+seen = set()
+lock = threading.Lock()
+
+
+def sink(message):
+    with lock:
+        seen.add(message.pod_identifier)
+    pool.add_task(message)
+
+
+def sink_batch(messages):
+    with lock:
+        for message in messages:
+            seen.add(message.pod_identifier)
+    pool.add_tasks(messages)
+
+
+ppool = PollerPool(
+    context=context,
+    config=PollerPoolConfig(pollers=1, poll_interval_ms=20),
+)
+for pod, endpoint in endpoints.items():
+    ppool.attach(
+        ChannelConfig(endpoint=endpoint, pod_identifier=pod),
+        sink,
+        sink_batch=sink_batch,
+    )
+
+deadline = time.monotonic() + float(spec["join_timeout_s"])
+while time.monotonic() < deadline and len(seen) < len(endpoints):
+    time.sleep(0.05)
+with open(spec["joined_path"], "w") as f:
+    f.write(str(len(seen)))
+deadline = time.monotonic() + 150
+while time.monotonic() < deadline and not os.path.exists(spec["go_path"]):
+    time.sleep(0.02)
+time.sleep(1.0)
+
+
+def hist_sum(hist):
+    total = 0.0
+    for metric in hist.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_sum"):
+                total = sample.value
+    return total
+
+
+before = hist_sum(METRICS.kvevents_batch_size)
+t0 = time.perf_counter()
+time.sleep(float(spec["window_s"]))
+elapsed = time.perf_counter() - t0
+applied = hist_sum(METRICS.kvevents_batch_size) - before
+with open(spec["result_path"], "w") as f:
+    json.dump(
+        {
+            "pods": len(endpoints),
+            "pods_joined": len(seen),
+            "applied_msgs_in_window": int(applied),
+            "window_s": round(elapsed, 2),
+            "apply_msgs_per_sec": round(applied / elapsed, 1),
+        },
+        f,
+    )
+ppool.shutdown()
+pool.shutdown()
+context.term()
+"""
+
+
+def _storm_replica_local_cell(
+    fleet, storm_endpoints: Dict[str, str], window: float
+) -> dict:
+    """Replica-local ingestion scaling: the same 1000-pod fleet
+    ingested by 1 vs 3 ingestor PROCESSES (each its own GIL), the pod
+    set sliced by the production rendezvous slicer
+    (``cluster.ingest.pod_owner``).  Offered load (STORM_RI_RATE) sits
+    above the aggregate capacity of the largest set so every cell is
+    measured at saturation; the aggregate apply rate across replicas
+    is the headline, ``scaling_1_to_3`` the claim.  ``cpu_count``
+    rides along because process-level scaling is physically bounded by
+    the cores available to the bench box."""
+    from llm_d_kv_cache_manager_tpu.cluster.ingest import pod_owner
+    from llm_d_kv_cache_manager_tpu.cluster.ring import HashRing
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    result: dict = {
+        "offered_msgs_per_sec": STORM_RI_RATE,
+        "cpu_count": os.cpu_count(),
+    }
+    for n_replicas in (1, 3):
+        _progress(
+            f"event_storm: replica-local ingestion, {n_replicas} replicas"
+        )
+        ring = HashRing([f"ingest-{i}" for i in range(n_replicas)])
+        slices: Dict[str, Dict[str, str]] = {r: {} for r in ring.members}
+        for pod, endpoint in storm_endpoints.items():
+            slices[pod_owner(ring, pod)][pod] = endpoint
+        workdir = tempfile.mkdtemp(prefix="kvtpu-storm-ri-")
+        ingestors = []
+        publisher = None
+        try:
+            go_path = os.path.join(workdir, "go")
+            src_path = os.path.join(workdir, "ingestor.py")
+            with open(src_path, "w") as f:
+                f.write(_STORM_INGESTOR_SRC)
+            joined_paths = []
+            result_paths = []
+            for replica_id in ring.members:
+                spec = {
+                    "repo_root": repo_root,
+                    "endpoints": slices[replica_id],
+                    "block_size": STORM_BLOCK_SIZE,
+                    "concurrency": 4,
+                    "window_s": window,
+                    "join_timeout_s": 120.0,
+                    "go_path": go_path,
+                    "joined_path": os.path.join(
+                        workdir, f"{replica_id}.joined"
+                    ),
+                    "result_path": os.path.join(
+                        workdir, f"{replica_id}.json"
+                    ),
+                }
+                joined_paths.append(spec["joined_path"])
+                result_paths.append(spec["result_path"])
+                spec_path = os.path.join(workdir, f"{replica_id}.spec")
+                with open(spec_path, "w") as f:
+                    json.dump(spec, f)
+                ingestors.append(
+                    subprocess.Popen(
+                        [sys.executable, src_path, spec_path],
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL,
+                    )
+                )
+            publisher, _pub_go = _spawn_storm_publisher(
+                workdir,
+                storm_endpoints,
+                fleet.payload,
+                STORM_RI_RATE,
+                duration=200.0 + window,
+            )
+            # _spawn_storm_publisher hardcodes its go file inside
+            # workdir — the same go_path the ingestor specs point at,
+            # so one touch releases saturation AND the measurement.
+            deadline = time.monotonic() + 130.0
+            while time.monotonic() < deadline and not all(
+                os.path.exists(p) for p in joined_paths
+            ):
+                time.sleep(0.1)
+            with open(go_path, "w"):
+                pass
+            deadline = time.monotonic() + 60.0 + window
+            for proc in ingestors:
+                remaining = max(1.0, deadline - time.monotonic())
+                try:
+                    proc.wait(timeout=remaining)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            per_replica = []
+            for path in result_paths:
+                try:
+                    with open(path) as f:
+                        per_replica.append(json.load(f))
+                except (OSError, ValueError):
+                    per_replica.append(None)
+            rates = [
+                cell["apply_msgs_per_sec"]
+                for cell in per_replica
+                if cell
+            ]
+            result[f"replicas_{n_replicas}"] = {
+                "per_replica": per_replica,
+                "aggregate_apply_msgs_per_sec": round(sum(rates), 1),
+                "pods_joined": sum(
+                    cell["pods_joined"] for cell in per_replica if cell
+                ),
+            }
+        finally:
+            for proc in ingestors:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+            if publisher is not None and publisher.poll() is None:
+                publisher.terminate()
+                try:
+                    publisher.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    publisher.kill()
+            shutil.rmtree(workdir, ignore_errors=True)
+    agg1 = result["replicas_1"]["aggregate_apply_msgs_per_sec"]
+    agg3 = result["replicas_3"]["aggregate_apply_msgs_per_sec"]
+    result["scaling_1_to_3"] = round(agg3 / agg1, 2) if agg1 else None
+    return result
 
 
 def bench_event_storm(
@@ -3575,7 +3856,7 @@ def bench_event_storm(
             )
             channels = []
 
-            def attach(sink, ppool=ppool, channels=channels):
+            def attach(sink, sink_batch, ppool=ppool, channels=channels):
                 for pod in fleet.pods:
                     channels.append(
                         ppool.attach(
@@ -3584,6 +3865,7 @@ def bench_event_storm(
                                 pod_identifier=pod,
                             ),
                             sink,
+                            sink_batch=sink_batch,
                         )
                     )
 
@@ -3612,7 +3894,9 @@ def bench_event_storm(
         _progress(f"event_storm: thread-per-pod baseline, N={n}")
         subscribers = []
 
-        def attach_baseline(sink):
+        def attach_baseline(sink, _sink_batch):
+            # The legacy subscriber has no batched sink — that IS the
+            # baseline being measured.
             for pod in fleet.pods:
                 sub = ZMQSubscriber(
                     ZMQSubscriberConfig(
@@ -3651,6 +3935,22 @@ def bench_event_storm(
             else None
         )
 
+        # Non-inversion regression guard (BENCH_r06: pollers=4 applied
+        # 324 msg/s vs 519 at pollers=1 — the O(lanes) shed scan under
+        # the shard lock convoyed pollers against workers).  Apply rate
+        # must be monotone-ish in pollers: a 0.85 tolerance absorbs
+        # scheduler noise at saturation (the seed inversion sat at
+        # 0.62x, far below it).
+        r1 = consolidated["apply_msgs_per_sec"]
+        r4 = result["consolidated_pollers_4"]["apply_msgs_per_sec"]
+        result["poller_scaling"] = {
+            "pollers_1_sps": r1,
+            "pollers_4_sps": r4,
+            "ratio_4_vs_1": round(r4 / r1, 3) if r1 else None,
+            "monotone_tolerance": 0.85,
+            "monotone_ok": bool(r1 and r4 >= 0.85 * r1),
+        }
+
         # -- fairness: per-pod budget on vs off ------------------------
         result["fairness"] = _storm_fairness_cells(
             context, fleet, run_id
@@ -3666,6 +3966,11 @@ def bench_event_storm(
             PodInventory,
             ResyncConfig,
             ResyncManager,
+        )
+
+        # -- replica-local ingestion scaling --------------------------
+        result["replica_local"] = _storm_replica_local_cell(
+            fleet, storm_endpoints, window
         )
         return result
     finally:

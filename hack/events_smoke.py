@@ -16,7 +16,16 @@ contracts from docs/event-plane.md:
 * a forced sequence gap marks the pod suspect and the anti-entropy
   resync repairs it: suspect set drains, the staleness histogram gains
   a sample, and the pod's inventory chain is re-claimed in the index;
-* a publisher seq regression counts as a restart, not a gap.
+* a publisher seq regression counts as a restart, not a gap;
+* replica-local ingestion (cluster/ingest.py): 3 in-process replicas
+  slice the fleet disjointly+completely, every pod's chain lands in
+  the cluster index through its slice owner, and killing one replica
+  mid-stream re-slices its pods onto the survivors whose takeover
+  resyncs re-ingest the slice — with no purge-resurrection (a block
+  the pod removed pre-kill must not reappear after failover).
+
+The throughput floor runs on the consolidated fast-lane path (batched
+sink + lock-free pre-decode), the production default.
 """
 
 from __future__ import annotations
@@ -27,6 +36,331 @@ import sys
 import threading
 import time
 import uuid
+
+
+def _replica_local_cell(context, block_size, run, model):
+    """Replica-local ingestion contracts (docs/event-plane.md):
+
+    * 3 in-process replicas (LocalCluster) each run their own poller
+      pool + kvevents pool over a ``ReplicaIngestor``-sliced pod set —
+      the slices are disjoint and complete;
+    * every pod's stored chain is claimed in the CLUSTER index (the
+      ingestors apply through the shared ``RemoteIndex`` view, so
+      pod-sliced subscriptions and key-sliced applies compose);
+    * killing one replica mid-stream re-slices its pods onto the
+      survivors, whose takeover resyncs re-ingest the slice from the
+      inventory source — full coverage restored;
+    * no purge-resurrection: a block its pod removed BEFORE the kill
+      must not reappear after the failover resync.
+    """
+    import struct
+    import threading
+    import time
+
+    import zmq
+
+    from llm_d_kv_cache_manager_tpu.cluster import LocalCluster
+    from llm_d_kv_cache_manager_tpu.cluster.ingest import (
+        ReplicaIngestor,
+        pod_owner,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+        EMPTY_BLOCK_HASH,
+        ChunkedTokenDatabase,
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.events import (
+        BlockRemoved,
+        BlockStored,
+        EventBatch,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.pool import Pool, PoolConfig
+    from llm_d_kv_cache_manager_tpu.kvevents.resync import (
+        CallableInventorySource,
+        PodInventory,
+        InventoryBlock,
+        ResyncConfig,
+        ResyncManager,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.subscriber_manager import (
+        SubscriberManager,
+    )
+
+    failures = []
+    n_pods = 24
+    pods = [f"ri-{run}-{i}" for i in range(n_pods)]
+    endpoints = {pod: f"inproc://{pod}" for pod in pods}
+    pub = {}
+    seqs = {pod: 0 for pod in pods}
+    for pod in pods:
+        sock = context.socket(zmq.PUB)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.bind(endpoints[pod])
+        pub[pod] = sock
+
+    def publish(pod, *events):
+        seqs[pod] += 1
+        pub[pod].send_multipart(
+            [
+                f"kv@{pod}@{model}".encode(),
+                struct.pack(">Q", seqs[pod]),
+                EventBatch(ts=0.0, events=list(events)).encode(),
+            ]
+        )
+
+    # Per-pod ground truth, served back by the takeover resyncs; the
+    # driver mutates it when a pod removes a block.
+    truth = {}
+    for i, pod in enumerate(pods):
+        base = 500_000 + 100 * i
+        blocks = []
+        parent = None
+        for b in range(2):
+            blocks.append(
+                InventoryBlock(
+                    block_hashes=[base + b],
+                    token_ids=[
+                        (base + b * block_size + j) % 5000 + 1
+                        for j in range(block_size)
+                    ],
+                    block_size=block_size,
+                    parent_block_hash=parent,
+                    medium="hbm",
+                )
+            )
+            parent = base + b
+        truth[pod] = blocks
+
+    source = CallableInventorySource(
+        lambda pod: PodInventory(
+            pod_identifier=pod,
+            model_name=model,
+            blocks=list(truth[pod]),
+        )
+    )
+
+    cluster = LocalCluster()
+    db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=block_size))
+    pools, resyncs, managers, ingestors = {}, {}, {}, {}
+    seen = {r: set() for r in cluster.replicas}
+    seen_lock = threading.Lock()
+    try:
+        for replica_id in cluster.replicas:
+            ri_pool = Pool(
+                cluster.remote_index, db, PoolConfig(concurrency=2)
+            )
+            ri_pool.start()
+            ri_resync = ResyncManager(
+                ri_pool, source, ResyncConfig(apply_timeout_s=30)
+            )
+            ri_resync.start()
+
+            def sink(message, replica_id=replica_id, ri_pool=ri_pool):
+                with seen_lock:
+                    seen[replica_id].add(message.pod_identifier)
+                ri_pool.add_task(message)
+
+            def sink_batch(
+                messages, replica_id=replica_id, ri_pool=ri_pool
+            ):
+                with seen_lock:
+                    for message in messages:
+                        seen[replica_id].add(message.pod_identifier)
+                ri_pool.add_tasks(messages)
+
+            ri_manager = SubscriberManager(
+                sink=sink,
+                sink_batch=sink_batch,
+                context=context,
+                pollers=1,
+                poll_interval_ms=10,
+                on_gap=ri_resync.gap_listener,
+            )
+            ingestor = ReplicaIngestor(
+                replica_id,
+                ri_manager,
+                membership=cluster.membership,
+                resync=ri_resync,
+            )
+            for pod in pods:
+                ingestor.ensure_subscriber(pod, endpoints[pod])
+            pools[replica_id] = ri_pool
+            resyncs[replica_id] = ri_resync
+            managers[replica_id] = ri_manager
+            ingestors[replica_id] = ingestor
+
+        # Slices must partition the fleet.
+        owned = {r: set(ing.owned_pods()) for r, ing in ingestors.items()}
+        union = set().union(*owned.values())
+        total = sum(len(s) for s in owned.values())
+        if union != set(pods) or total != len(pods):
+            failures.append(
+                f"replica slices do not partition the fleet: "
+                f"{ {r: len(s) for r, s in owned.items()} }"
+            )
+        ring = cluster.membership.ring()
+        for replica_id, pods_owned in owned.items():
+            for pod in pods_owned:
+                if pod_owner(ring, pod) != replica_id:
+                    failures.append(
+                        f"pod {pod} subscribed by {replica_id} but "
+                        f"owned by {pod_owner(ring, pod)}"
+                    )
+
+        # Join: PUB/SUB is lossy pre-subscribe — warm up until every
+        # pod is seen by its owner.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            live = set().union(*seen.values())
+            if live >= set(pods):
+                break
+            for pod in pods:
+                if pod not in live:
+                    publish(pod)
+            time.sleep(0.05)
+        else:
+            failures.append("replica-local subscriptions never all joined")
+        for ri_pool in pools.values():
+            ri_pool.drain()
+
+        # Live stream: each pod stores its 2-block truth chain.
+        for pod in pods:
+            for block in truth[pod]:
+                publish(
+                    pod,
+                    BlockStored(
+                        block_hashes=list(block.block_hashes),
+                        parent_block_hash=block.parent_block_hash,
+                        token_ids=list(block.token_ids),
+                        block_size=block_size,
+                        medium="hbm",
+                    ),
+                )
+        for ri_pool in pools.values():
+            ri_pool.drain()
+
+        def chain_keys(pod):
+            tokens = [
+                t for block in truth[pod] for t in block.token_ids
+            ]
+            return db.tokens_to_kv_block_keys(
+                EMPTY_BLOCK_HASH, tokens, model
+            )
+
+        def cluster_claims(pod, keys):
+            found = cluster.remote_index.lookup(keys, None)
+            return sum(
+                1
+                for entries in found.values()
+                if any(e.pod_identifier == pod for e in entries)
+            )
+
+        # PUB delivery is async — poll until the claims land (drain()
+        # only covers messages already in the shard queues).
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            missing = sum(
+                1
+                for pod in pods
+                if cluster_claims(pod, chain_keys(pod))
+                != len(truth[pod])
+            )
+            if not missing:
+                break
+            time.sleep(0.1)
+        if missing:
+            failures.append(
+                f"{missing}/{n_pods} pods' chains not fully claimed in "
+                "the cluster index before the kill"
+            )
+
+        # One pod REMOVES its 2nd block pre-kill (truth updated): the
+        # resurrection bait for the failover resync.
+        victim_replica = sorted(cluster.replicas)[0]
+        victim_pods = sorted(owned[victim_replica])
+        if not victim_pods:
+            failures.append(
+                f"replica {victim_replica} owns no pods; cannot "
+                "exercise failover"
+            )
+            return failures
+        bait_pod = victim_pods[0]
+        bait_keys = chain_keys(bait_pod)
+        removed_block = truth[bait_pod].pop()
+        publish(
+            bait_pod,
+            BlockRemoved(
+                block_hashes=list(removed_block.block_hashes),
+                medium="hbm",
+            ),
+        )
+        # The eviction must land BEFORE the kill or the bait is moot.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            found = cluster.remote_index.lookup([bait_keys[-1]], None)
+            if not any(
+                e.pod_identifier == bait_pod
+                for entries in found.values()
+                for e in entries
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            failures.append("pre-kill eviction never applied")
+
+        # Kill the replica: membership listeners re-slice inline; the
+        # survivors' takeover resyncs re-ingest the slice async.
+        cluster.kill(victim_replica)
+        ring = cluster.membership.ring()
+        if any(
+            pod_owner(ring, pod) == victim_replica for pod in pods
+        ):
+            failures.append("dead replica still owns pods on the ring")
+        leftover = set(ingestors[victim_replica].owned_pods())
+        if leftover:
+            failures.append(
+                f"dead replica's ingestor kept {len(leftover)} pods"
+            )
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(
+                cluster_claims(pod, chain_keys(pod)) == len(truth[pod])
+                for pod in victim_pods
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            failures.append(
+                "failover owners never re-ingested the dead replica's "
+                "slice"
+            )
+
+        # No purge-resurrection: the pre-kill removed block must stay
+        # gone after the takeover resync (inventory no longer lists it).
+        found = cluster.remote_index.lookup(bait_keys, None)
+        resurrection = [
+            key
+            for key, entries in found.items()
+            if key == bait_keys[-1]
+            and any(e.pod_identifier == bait_pod for e in entries)
+        ]
+        if resurrection:
+            failures.append(
+                "failover resync resurrected a block the pod removed "
+                "before the kill"
+            )
+    finally:
+        for ri_manager in managers.values():
+            ri_manager.shutdown()
+        for ri_resync in resyncs.values():
+            ri_resync.close()
+        for ri_pool in pools.values():
+            ri_pool.shutdown()
+        cluster.close()
+        for sock in pub.values():
+            sock.close()
+    return failures
 
 
 def main() -> int:
@@ -143,8 +477,15 @@ def main() -> int:
             seen.add(message.pod_identifier)
         pool.add_task(message)
 
+    def sink_batch(messages):
+        with seen_lock:
+            for message in messages:
+                seen.add(message.pod_identifier)
+        pool.add_tasks(messages)
+
     manager = SubscriberManager(
         sink=sink,
+        sink_batch=sink_batch,
         context=context,
         pollers=1,
         poll_interval_ms=10,
@@ -309,6 +650,11 @@ def main() -> int:
             gaps_before
         ):
             failures.append("publisher restart inflated the gap counter")
+
+        # -- replica-local ingestion: slice, kill, failover ----------
+        failures.extend(
+            _replica_local_cell(context, block_size, run, model)
+        )
     finally:
         manager.shutdown()
         resync.close()
@@ -325,7 +671,7 @@ def main() -> int:
     print(
         f"events smoke ok: {n_pods} pods, {rate:.0f} msgs/s applied, "
         f"{threads} event-plane threads, gap resynced, restart "
-        "classified",
+        "classified, replica-local ingestion failover clean",
         file=sys.stderr,
     )
     return 0

@@ -966,9 +966,64 @@ def main() -> None:  # pragma: no cover - CLI entry
     )
     manager = SubscriberManager(
         sink=pool.add_task,
+        # Batched fast-lane delivery: each poller burst is one
+        # enqueue + one lock-free pre-decode pass (event-plane.md).
+        sink_batch=pool.add_tasks,
         bind=not discover,
         on_gap=resync.gap_listener if resync else None,
     )
+    # CLUSTER_LOCAL_INGEST=1 (replica mode + discovery): this replica
+    # subscribes to only its pod slice of the fleet — the event plane's
+    # write throughput then scales with the replica count instead of
+    # funneling through one process (docs/event-plane.md).  The
+    # reconciler announces the whole fleet; the ingestor slices it over
+    # the member ring and re-slices on ring changes.
+    ingestor = None
+    if os.environ.get("CLUSTER_LOCAL_INGEST", "").lower() in (
+        "1",
+        "true",
+        "yes",
+    ):
+        members_raw = os.environ.get("CLUSTER_MEMBERS", "")
+        self_id = os.environ.get("CLUSTER_SELF", "")
+        if not (
+            discover
+            and members_raw
+            and self_id
+            and cluster_membership is not None
+        ):
+            # CLUSTER_REPLICAS (the router wiring) is load-bearing,
+            # not optional: it injects the RemoteIndex the pool
+            # applies through (pod-sliced subscriptions + KEY-sliced
+            # applies compose only then — a local backend would strand
+            # ~(N-1)/N of claims on the wrong replica) and provides
+            # the membership whose ring bumps drive re-slicing.
+            logger.warning(
+                "CLUSTER_LOCAL_INGEST needs POD_DISCOVERY, "
+                "CLUSTER_SELF, CLUSTER_MEMBERS and CLUSTER_REPLICAS "
+                "(the RemoteIndex apply path + ring membership); "
+                "ignoring"
+            )
+        else:
+            from llm_d_kv_cache_manager_tpu.cluster.ingest import (
+                ReplicaIngestor,
+            )
+            from llm_d_kv_cache_manager_tpu.cluster.ring import HashRing
+
+            ingestor = ReplicaIngestor(
+                self_id,
+                manager,
+                ring=HashRing(
+                    [
+                        m.strip()
+                        for m in members_raw.split(",")
+                        if m.strip()
+                    ]
+                ),
+                membership=cluster_membership,
+                resync=resync,
+            )
+
     reconciler = None
     if discover:
         from llm_d_kv_cache_manager_tpu.kvevents.pod_reconciler import (
@@ -978,7 +1033,7 @@ def main() -> None:  # pragma: no cover - CLI entry
         )
 
         reconciler = PodReconciler(
-            manager,
+            ingestor if ingestor is not None else manager,
             PodReconcilerConfig(
                 namespace=os.environ.get("POD_NAMESPACE") or None,
                 label_selector=os.environ.get(
@@ -1012,6 +1067,9 @@ def main() -> None:  # pragma: no cover - CLI entry
         }
         if resync is not None:
             status["resync"] = resync.stats()
+        if ingestor is not None:
+            status["local_ingest"] = ingestor.status()
+        status["stages"] = pool.stage_stats()
         return status
 
     server = serve(

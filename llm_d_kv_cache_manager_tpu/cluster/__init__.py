@@ -23,6 +23,11 @@ the inter-process analogue of the striped ``InMemoryIndex``):
 * :mod:`membership` — static replica config + heartbeat health; a
   missed-heartbeat replica is removed from the ring (version bump,
   failover counter) and its keys route to their rendezvous runner-up.
+* :mod:`ingest` — replica-local ingestion: the pod fleet's event
+  streams are sliced over the same ring (pod-id rendezvous), each
+  replica subscribing to only its slice, so write throughput scales
+  with the replica count; ring bumps re-slice and takeover pods are
+  resynced (docs/event-plane.md).
 
 See docs/replication.md for the topology and the failover state
 machine; ``CLUSTER_*`` env wiring lives in ``api/http_service.py``.
@@ -30,6 +35,12 @@ machine; ``CLUSTER_*`` env wiring lives in ``api/http_service.py``.
 
 from llm_d_kv_cache_manager_tpu.cluster.harness import (  # noqa: F401
     LocalCluster,
+)
+from llm_d_kv_cache_manager_tpu.cluster.ingest import (  # noqa: F401
+    ReplicaIngestor,
+    pod_owner,
+    pod_slice_key,
+    slice_pods,
 )
 from llm_d_kv_cache_manager_tpu.cluster.membership import (  # noqa: F401
     ClusterMembership,

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from llm_d_kv_cache_manager_tpu.cluster.replica import ReplicaUnavailable
 from llm_d_kv_cache_manager_tpu.cluster.ring import HashRing
@@ -58,6 +58,12 @@ class ClusterMembership:
         self.full_ring = HashRing(sorted(self._transports))
         self._failover_count = 0  # guarded-by: _lock
         self._last_heartbeat: Dict[str, float] = {}  # guarded-by: _lock
+        # Ring-change listeners (replica-local ingestion re-slices its
+        # pod subscriptions on every version bump — cluster/ingest.py).
+        # Invoked OUTSIDE the membership lock with the new ring.
+        self._listeners: List[Callable[[HashRing], None]] = (
+            []
+        )  # guarded-by: _lock
         METRICS.cluster_ring_version.set(self._ring.version)
         METRICS.cluster_replicas_alive.set(len(self._alive))
 
@@ -101,6 +107,25 @@ class ClusterMembership:
                 },
             }
 
+    def add_listener(
+        self, listener: Callable[[HashRing], None]
+    ) -> None:
+        """Register a ring-change listener, called with the NEW alive
+        ring after every version bump (mark_dead/mark_alive), outside
+        the membership lock.  Listener exceptions are swallowed (a
+        broken consumer must not wedge failover)."""
+        with self._lock:
+            self._listeners.append(listener)
+
+    def _notify_ring_change(self, ring: HashRing) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for listener in listeners:
+            try:
+                listener(ring)
+            except Exception:  # noqa: BLE001 — consumer bugs stay theirs
+                logger.exception("ring-change listener failed")
+
     # -- writes ---------------------------------------------------------
 
     def mark_dead(self, replica_id: str, reason: str = "") -> bool:
@@ -121,7 +146,8 @@ class ClusterMembership:
             self._alive.discard(replica_id)
             self._ring = self._ring.without(replica_id)
             self._failover_count += 1
-            version = self._ring.version
+            ring = self._ring
+            version = ring.version
             alive = len(self._alive)
         METRICS.cluster_failovers.inc()
         METRICS.cluster_ring_version.set(version)
@@ -133,6 +159,7 @@ class ClusterMembership:
             version,
             alive,
         )
+        self._notify_ring_change(ring)
         return True
 
     def mark_alive(self, replica_id: str) -> bool:
@@ -148,7 +175,8 @@ class ClusterMembership:
                 return False
             self._alive.add(replica_id)
             self._ring = self._ring.with_member(replica_id)
-            version = self._ring.version
+            ring = self._ring
+            version = ring.version
             alive = len(self._alive)
         METRICS.cluster_ring_version.set(version)
         METRICS.cluster_replicas_alive.set(alive)
@@ -158,6 +186,7 @@ class ClusterMembership:
             version,
             alive,
         )
+        self._notify_ring_change(ring)
         return True
 
 

@@ -40,7 +40,10 @@ from typing import Callable, Dict, List, Optional
 
 import zmq
 
-from llm_d_kv_cache_manager_tpu.kvevents.pool import Message
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    Message,
+    resolve_lockfree_decode_env,
+)
 from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
     GapListener,
     TopicSeqTracker,
@@ -82,6 +85,11 @@ class PollerPoolConfig:
     # Reconnect backoff after a socket error, scheduled on the poller's
     # clock (no per-pod sleeping thread).
     reconnect_backoff_s: float = 5.0
+    # Zero-copy receive: payload frames are passed downstream as
+    # memoryviews over the ZMQ message (no bytes copy per event).
+    # None -> the same KVEVENTS_LOCKFREE_DECODE env the pool's
+    # pre-decode stage reads — one knob flips the whole fast lane.
+    zero_copy: Optional[bool] = None
 
     def resolved_pollers(self) -> int:
         n = self.pollers
@@ -94,6 +102,11 @@ class PollerPoolConfig:
         if ms is None:
             ms = _env_int("KVEVENTS_POLL_MS", 50)
         return max(1, ms)
+
+    def resolved_zero_copy(self) -> bool:
+        if self.zero_copy is not None:
+            return self.zero_copy
+        return resolve_lockfree_decode_env()
 
 
 @dataclass
@@ -121,6 +134,7 @@ class Channel:
     __slots__ = (
         "config",
         "sink",
+        "sink_batch",
         "on_gap",
         "tracker",
         "sock",
@@ -134,9 +148,15 @@ class Channel:
         config: ChannelConfig,
         sink: Callable[[Message], None],
         on_gap: Optional[GapListener] = None,
+        sink_batch: Optional[Callable[[List[Message]], None]] = None,
     ) -> None:
         self.config = config
         self.sink = sink
+        # Batched delivery (``Pool.add_tasks``): one sink call per
+        # socket burst instead of one per message — one shard-lock
+        # round trip and one metrics pass for the whole burst.  When
+        # None, messages are delivered one by one through ``sink``.
+        self.sink_batch = sink_batch
         self.on_gap = on_gap
         self.tracker = TopicSeqTracker()
         self.sock: Optional[zmq.Socket] = None
@@ -157,11 +177,13 @@ class _Poller:
         context: zmq.Context,
         poll_interval_ms: int,
         reconnect_backoff_s: float,
+        zero_copy: bool = True,
     ) -> None:
         self.index = index
         self._context = context
         self._poll_ms = poll_interval_ms
         self._backoff_s = reconnect_backoff_s
+        self._zero_copy = zero_copy
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # Pending attach(+)/detach(-) commands from other threads; the
@@ -362,13 +384,33 @@ class _Poller:
             channels.clear()
 
     def _drain_socket(self, channel: Channel) -> None:
-        """Receive up to MAX_RECV_PER_SOCKET messages without blocking."""
+        """Receive up to MAX_RECV_PER_SOCKET messages without blocking,
+        then deliver the burst in ONE batched sink call when the
+        channel has one (``sink_batch`` -> ``Pool.add_tasks``: one
+        shard-lock round trip for the whole burst, and the lock-free
+        decode stage runs here on this poller thread).  Zero-copy mode
+        hands the payload frame downstream as a memoryview — the tiny
+        topic/seq frames are copied, the msgpack body is not."""
         assert channel.sock is not None
+        batch: List[Message] = []
         for _ in range(MAX_RECV_PER_SOCKET):
             try:
-                parts = channel.sock.recv_multipart(zmq.NOBLOCK)
+                if self._zero_copy:
+                    frames = channel.sock.recv_multipart(
+                        zmq.NOBLOCK, copy=False
+                    )
+                    if len(frames) == 3:
+                        parts = [
+                            frames[0].bytes,
+                            frames[1].bytes,
+                            frames[2].buffer,
+                        ]
+                    else:
+                        parts = [f.bytes for f in frames]
+                else:
+                    parts = channel.sock.recv_multipart(zmq.NOBLOCK)
             except zmq.Again:
-                return
+                break
             if channel.detached:
                 return
             message = parse_event_message(
@@ -380,6 +422,20 @@ class _Poller:
             )
             if message is None:
                 continue
+            batch.append(message)
+        if not batch or channel.detached:
+            return
+        if channel.sink_batch is not None:
+            try:
+                channel.sink_batch(batch)
+            except Exception:  # noqa: BLE001 — sink bugs must not kill us
+                logger.exception(
+                    "batch sink failed for %d messages from %s; dropping",
+                    len(batch),
+                    channel.config.pod_identifier,
+                )
+            return
+        for message in batch:
             try:
                 channel.sink(message)
             except Exception:  # noqa: BLE001 — sink bugs must not kill us
@@ -414,6 +470,7 @@ class PollerPool:
             self._context,
             self.config.resolved_poll_ms(),
             self.config.reconnect_backoff_s,
+            zero_copy=self.config.resolved_zero_copy(),
         )
         poller.start()
         return poller
@@ -450,9 +507,10 @@ class PollerPool:
         config: ChannelConfig,
         sink: Callable[[Message], None],
         on_gap: Optional[GapListener] = None,
+        sink_batch: Optional[Callable[[List[Message]], None]] = None,
     ) -> Channel:
         pollers = self._ensure_started()
-        channel = Channel(config, sink, on_gap=on_gap)
+        channel = Channel(config, sink, on_gap=on_gap, sink_batch=sink_batch)
         target = min(pollers, key=lambda p: p.assigned())
         target.attach(channel)
         return channel

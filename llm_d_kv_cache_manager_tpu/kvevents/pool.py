@@ -45,6 +45,24 @@ the ``kvtpu_kvevents_pod_backlog{pod=...}`` gauge.
 ``PoolConfig.per_pod_flow_control=False`` restores the legacy global
 FIFO + drop-oldest (the bench A/B baseline).
 
+**Write-path fast lane** (docs/event-plane.md): enqueue is batched
+(``add_tasks``: one shard-lock round trip per drained socket burst,
+metrics batched outside every lock) and the overflow victim — the
+longest lane — is picked O(1) from depth buckets instead of an
+O(lanes) ``max`` scan under the shard lock (the scan serialized
+enqueueing pollers against draining workers at saturation; BENCH_r06's
+pollers=4 < pollers=1 inversion).  With ``PoolConfig.lockfree_decode``
+(``KVEVENTS_LOCKFREE_DECODE``, default on) payloads are msgpack-decoded
+on the enqueueing thread BEFORE the shard queue — a lock-free stage
+over (possibly zero-copy ``memoryview``) payloads — and workers apply
+pre-decoded batches; off restores the straight in-worker decode, the
+parity oracle the write-path tests pin.  ``KVEVENTS_DIGEST_MEMO``
+bounds a per-worker LRU of digested request-key chains so repeated
+stores of the same block chain skip re-hashing (pure function of
+parent key + model + tokens, so no invalidation exists to get wrong).
+``stage_stats()`` reports the cumulative decode/apply wall-time split
+for the bench's bottleneck attribution.
+
 **Resync commands**: the anti-entropy path (``kvevents/resync.py``)
 repairs a pod whose event stream gapped by enqueueing a
 :class:`ResyncJob` through :meth:`Pool.enqueue_resync`.  The job rides
@@ -57,6 +75,7 @@ pod suspect forever); a shutdown drop reports failure to the waiter.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -108,6 +127,17 @@ lockorder.declare_order("Pool._lock", "ShardQueue._lock")
 # TPU pods' on-chip tier; events without an explicit medium default here
 # (GPU-era fleets default to "gpu" — both score 1.0 by default).
 DEFAULT_EVENT_SOURCE_DEVICE_TIER = "hbm"
+
+
+def resolve_lockfree_decode_env() -> bool:
+    """The KVEVENTS_LOCKFREE_DECODE knob, shared by the pool's
+    pre-decode stage and the poller's zero-copy receive so the two
+    halves of the fast lane cannot drift apart.  Programmatic A/B runs
+    that force ``PoolConfig(lockfree_decode=...)`` should set the
+    poller's ``zero_copy`` to match."""
+    return os.environ.get(
+        "KVEVENTS_LOCKFREE_DECODE", "1"
+    ).lower() not in ("0", "false", "no")
 
 _FNV32_OFFSET = 0x811C9DC5
 _FNV32_PRIME = 0x01000193
@@ -164,9 +194,20 @@ class ResyncJob:
                 )
 
 
+# Sentinel marking a message whose payload failed the lock-free
+# pre-decode stage (poison pill discovered before the shard queue):
+# the worker drops it without re-decoding.
+_DECODE_FAILED = object()
+
+
 @dataclass
 class Message:
-    """One raw event-stream message as received from a pod."""
+    """One raw event-stream message as received from a pod.
+
+    ``payload`` may be ``bytes`` or a ``memoryview`` over the ZMQ frame
+    (the poller's zero-copy path); it is only ever read by the decode
+    stage, which accepts any bytes-like object.
+    """
 
     topic: str
     payload: bytes
@@ -185,6 +226,12 @@ class Message:
     # purges + re-applies instead of decoding ``payload``; such command
     # messages are never shed by flow control.
     resync: Optional[ResyncJob] = None
+    # Decoded EventBatch produced by the lock-free pre-decode stage
+    # (``Pool.add_tasks`` with ``lockfree_decode`` on, running on the
+    # enqueueing thread with no locks held): the worker skips its own
+    # decode when set.  ``_DECODE_FAILED`` marks a poison pill already
+    # counted/logged at pre-decode time.
+    decoded: Optional[object] = None
 
 
 @dataclass
@@ -211,11 +258,41 @@ class PoolConfig:
     # drop-oldest shedding (no lanes, no budget) — the event_storm
     # bench's A/B baseline and an escape hatch.
     per_pod_flow_control: bool = True
+    # Lock-free decode stage: payloads are msgpack-decoded on the
+    # enqueueing (poller) thread BEFORE the shard queue, with no locks
+    # held, so workers spend their time applying.  None -> the
+    # KVEVENTS_LOCKFREE_DECODE env (default on); False keeps the
+    # straight in-worker decode path — the parity oracle the
+    # write-path tests pin (docs/event-plane.md).
+    lockfree_decode: Optional[bool] = None
+    # Per-worker LRU of digested request-key chains keyed by
+    # (parent request key, model, token ids): repeated stores of the
+    # same block chain (shared prefixes fleet-wide, resync re-applies)
+    # skip re-hashing entirely — block keys are pure functions of that
+    # key, so the memo never needs invalidation (the PR-4 read-path
+    # memo argument, applied to the write path).  None -> the
+    # KVEVENTS_DIGEST_MEMO env (default 4096 entries); 0 disables.
+    digest_memo: Optional[int] = None
 
     def effective_pod_budget(self) -> int:
         if self.pod_budget is None:
             return self.max_queue_depth
         return max(1, self.pod_budget)
+
+    def resolved_lockfree_decode(self) -> bool:
+        if self.lockfree_decode is not None:
+            return self.lockfree_decode
+        return resolve_lockfree_decode_env()
+
+    def resolved_digest_memo(self) -> int:
+        if self.digest_memo is not None:
+            return max(0, self.digest_memo)
+        try:
+            return max(
+                0, int(os.environ.get("KVEVENTS_DIGEST_MEMO", "4096"))
+            )
+        except ValueError:
+            return 4096
 
 
 class _ShardQueue:
@@ -249,12 +326,38 @@ class _ShardQueue:
             OrderedDict()
         )  # guarded-by: _lock
         self._regular: Dict[str, int] = {}  # guarded-by: _lock
+        # Inverse index of ``_regular`` (depth -> ordered set of lane
+        # keys at that depth) plus the current maximum, so the
+        # overflow victim — the longest lane — is an O(1) pick.  The
+        # old ``max(self._regular, key=...)`` was an O(lanes) scan
+        # UNDER THE SHARD LOCK on every overflowing put: at saturation
+        # with ~250 lanes/shard every enqueue paid it, pollers and
+        # workers convoyed on the lock, and adding pollers made apply
+        # throughput WORSE (the pollers=4 < pollers=1 inversion in
+        # BENCH_r06).  Depths change by ±1 per operation, so bucket
+        # moves (and the max's downward walk) are amortized O(1).
+        self._by_depth: Dict[int, Dict[str, None]] = {}  # guarded-by: _lock
+        self._max_lane = 0  # guarded-by: _lock
         self._size = 0  # guarded-by: _lock  (regular messages only)
         self._unfinished = 0  # guarded-by: _lock  (incl. commands)
         self._closed = False  # guarded-by: _lock
 
     def _lane_key(self, message: Message) -> str:
         return message.pod_identifier if self._per_pod else ""
+
+    def _depth_move_locked(self, key: str, old: int, new: int) -> None:
+        """Track one lane's regular-depth change in the depth buckets."""
+        if old > 0:
+            bucket = self._by_depth[old]
+            del bucket[key]
+            if not bucket:
+                del self._by_depth[old]
+        if new > 0:
+            self._by_depth.setdefault(new, {})[key] = None
+            if new > self._max_lane:
+                self._max_lane = new
+        while self._max_lane and self._max_lane not in self._by_depth:
+            self._max_lane -= 1
 
     def _shed_from_locked(
         self, key: str, reason: str, shed: List[Tuple[Message, str]]
@@ -274,13 +377,55 @@ class _ShardQueue:
             lane.appendleft(command)
         if victim is None:  # pragma: no cover — guarded by _regular
             return
-        self._regular[key] -= 1
+        depth = self._regular[key]
+        self._regular[key] = depth - 1
+        self._depth_move_locked(key, depth, depth - 1)
         self._size -= 1
         self._unfinished -= 1
         if not lane:
             del self._lanes[key]
             del self._regular[key]
         shed.append((victim, reason))
+
+    def _put_locked(
+        self, message: Message, shed: List[Tuple[Message, str]]
+    ) -> int:
+        """Admit one message (caller holds the lock, queue not closed);
+        returns the admitting lane's post-put regular depth."""
+        key = self._lane_key(message)
+        is_command = message.resync is not None
+        lane = self._lanes.get(key)
+        if not is_command:
+            # Overflow outranks the budget label: at whole-shard
+            # capacity the drop IS a queue_full event (the reason
+            # dashboards have always alerted on), whoever the
+            # victim — the longest lane, which is at or above its
+            # effective budget by construction.  The pod_budget
+            # reason is reserved for a pod hitting its own budget
+            # while the shard still has room (otherwise legacy
+            # single-lane mode, whose budget equals the depth,
+            # would relabel every overflow drop).
+            if self._size >= self._max_depth:
+                victim_key = next(iter(self._by_depth[self._max_lane]))
+                self._shed_from_locked(victim_key, "queue_full", shed)
+            elif (
+                lane is not None
+                and self._regular.get(key, 0) >= self._pod_budget
+            ):
+                self._shed_from_locked(key, "pod_budget", shed)
+            lane = self._lanes.get(key)
+        if lane is None:
+            lane = deque()
+            self._lanes[key] = lane
+            self._regular[key] = 0
+        lane.append(message)
+        if not is_command:
+            depth = self._regular[key] + 1
+            self._regular[key] = depth
+            self._depth_move_locked(key, depth - 1, depth)
+            self._size += 1
+        self._unfinished += 1
+        return self._regular[key]
 
     def put(self, message: Message) -> Tuple[List[Tuple[Message, str]], int]:
         """Admit a message, shedding per the flow-control policy.
@@ -290,46 +435,32 @@ class _ShardQueue:
         and the admitting pod's lane depth after the put (-1 when the
         message itself was rejected at shutdown).
         """
-        key = self._lane_key(message)
-        is_command = message.resync is not None
         shed: List[Tuple[Message, str]] = []
         with self._lock:
             if self._closed:
                 return [(message, "shutdown")], -1
-            lane = self._lanes.get(key)
-            if not is_command:
-                # Overflow outranks the budget label: at whole-shard
-                # capacity the drop IS a queue_full event (the reason
-                # dashboards have always alerted on), whoever the
-                # victim — the longest lane, which is at or above its
-                # effective budget by construction.  The pod_budget
-                # reason is reserved for a pod hitting its own budget
-                # while the shard still has room (otherwise legacy
-                # single-lane mode, whose budget equals the depth,
-                # would relabel every overflow drop).
-                if self._size >= self._max_depth:
-                    victim_key = max(
-                        self._regular, key=self._regular.__getitem__
-                    )
-                    self._shed_from_locked(victim_key, "queue_full", shed)
-                elif (
-                    lane is not None
-                    and self._regular.get(key, 0) >= self._pod_budget
-                ):
-                    self._shed_from_locked(key, "pod_budget", shed)
-                lane = self._lanes.get(key)
-            if lane is None:
-                lane = deque()
-                self._lanes[key] = lane
-                self._regular[key] = 0
-            lane.append(message)
-            if not is_command:
-                self._regular[key] += 1
-                self._size += 1
-            self._unfinished += 1
-            depth = self._regular[key]
+            depth = self._put_locked(message, shed)
             self._lock.notify_all()
         return shed, depth
+
+    def put_batch(
+        self, messages: Sequence[Message]
+    ) -> Tuple[List[Tuple[Message, str]], Dict[str, int]]:
+        """Admit many messages under ONE lock round-trip (the batched
+        poller sink).  Returns ``(shed, depths)``: displaced messages
+        as in :meth:`put`, and each admitting pod's post-put lane depth
+        (shutdown-rejected messages land in ``shed`` only)."""
+        shed: List[Tuple[Message, str]] = []
+        depths: Dict[str, int] = {}
+        with self._lock:
+            if self._closed:
+                return [(m, "shutdown") for m in messages], {}
+            for message in messages:
+                depths[message.pod_identifier] = self._put_locked(
+                    message, shed
+                )
+            self._lock.notify_all()
+        return shed, depths
 
     def get_batch(
         self, limit: int
@@ -351,7 +482,9 @@ class _ShardQueue:
                 message = lane.popleft()
                 batch.append(message)
                 if message.resync is None:
-                    self._regular[key] -= 1
+                    depth = self._regular[key]
+                    self._regular[key] = depth - 1
+                    self._depth_move_locked(key, depth, depth - 1)
                     self._size -= 1
                 depths[key] = self._regular.get(key, 0)
                 if lane:
@@ -499,6 +632,15 @@ class _BatchApplier:
             return request_key
         return self._index.get_request_key(engine_key)
 
+    def forget_mapping(self, engine_key: int) -> None:
+        """Drop a batch-cached mapping after an eviction so parent
+        resolution falls back to the index — the ground truth for
+        whether the key survived.  Without this, a store chaining off
+        an in-batch-evicted parent resolved or skipped depending on
+        where the worker's batch boundary happened to fall (and the
+        coalesced/uncoalesced streams could diverge the same way)."""
+        self._mappings.pop(engine_key, None)
+
     def flush(self) -> None:
         """Apply deferred admissions (grouped per shard), then journal
         them.  Called before any eviction and at batch end."""
@@ -569,6 +711,27 @@ class Pool:
         self._threads: List[threading.Thread] = []  # guarded-by: _lock
         self._started = False  # guarded-by: _lock
         self._lock = lockorder.tracked(threading.Lock(), "Pool._lock")
+        self._lockfree_decode = self.config.resolved_lockfree_decode()
+        self._digest_memo_size = self.config.resolved_digest_memo()
+        # Hot-path caches (racy-benign: values are deterministic, a
+        # lost write is recomputed).  Bounded so a malformed-topic
+        # flood cannot grow them without limit.
+        self._shard_cache: Dict[str, int] = {}
+        self._backlog_gauges: Dict[str, object] = {}
+        self._shed_counters: Dict[str, object] = {}
+        # Cumulative decode/apply wall-time split, wherever each stage
+        # ran (pre-decode on the enqueueing thread or in-worker).  Fed
+        # per batch, read by stage_stats() — the bench's
+        # decode-vs-apply attribution.
+        self._stage_lock = lockorder.tracked(
+            threading.Lock(), "Pool._stage_lock"
+        )
+        self._stage = {  # guarded-by: _stage_lock
+            "decode_s": 0.0,
+            "decode_msgs": 0,
+            "apply_s": 0.0,
+            "apply_msgs": 0,
+        }
 
     def start(self) -> None:
         with self._lock:
@@ -618,11 +781,50 @@ class Pool:
         if dropped.resync is not None:
             dropped.resync._finish(False, 0, f"dropped: {reason}")
 
-    def _shard_for(self, pod_identifier: str) -> _ShardQueue:
-        shard = fnv1a_32(pod_identifier.encode()) % len(self._queues)
-        return self._queues[shard]
+    def _shard_index(self, pod_identifier: str) -> int:
+        shard = self._shard_cache.get(pod_identifier)
+        if shard is None:
+            shard = fnv1a_32(pod_identifier.encode()) % len(self._queues)
+            if len(self._shard_cache) < 131072:
+                self._shard_cache[pod_identifier] = shard
+        return shard
 
-    def add_task(self, message: Message) -> None:
+    def _shard_for(self, pod_identifier: str) -> _ShardQueue:
+        return self._queues[self._shard_index(pod_identifier)]
+
+    def _backlog_gauge(self, pod_identifier: str):
+        gauge = self._backlog_gauges.get(pod_identifier)
+        if gauge is None:
+            gauge = METRICS.kvevents_pod_backlog.labels(
+                pod=safe_label(pod_identifier)
+            )
+            if len(self._backlog_gauges) < 131072:
+                self._backlog_gauges[pod_identifier] = gauge
+        return gauge
+
+    def _shed_counter(self, pod_identifier: str):
+        counter = self._shed_counters.get(pod_identifier)
+        if counter is None:
+            counter = METRICS.kvevents_pod_shed.labels(
+                pod=safe_label(pod_identifier)
+            )
+            if len(self._shed_counters) < 131072:
+                self._shed_counters[pod_identifier] = counter
+        return counter
+
+    def _stage_account(self, stage: str, seconds: float, msgs: int) -> None:
+        with self._stage_lock:
+            self._stage[f"{stage}_s"] += seconds
+            self._stage[f"{stage}_msgs"] += msgs
+
+    def stage_stats(self) -> dict:
+        """Cumulative decode vs apply wall-time split (seconds and
+        message counts), wherever each stage ran — the bench's
+        bottleneck attribution (docs/event-plane.md)."""
+        with self._stage_lock:
+            return dict(self._stage)
+
+    def _prepare_message(self, message: Message) -> None:
         if message.trace is None:
             tr = TRACER.start_trace("kvevents.message")
             if tr is not None:
@@ -632,24 +834,85 @@ class Pool:
                 message.trace = tr
         if message.trace is not None:
             message.enqueued_at = time.perf_counter()
-        q = self._shard_for(message.pod_identifier)
-        shed, depth = q.put(message)
-        # Metrics + trace finishing OUTSIDE the shard lock.
-        for dropped, reason in shed:
-            METRICS.kvevents_dropped.labels(reason=reason).inc()
-            METRICS.kvevents_pod_shed.labels(
-                pod=safe_label(dropped.pod_identifier)
-            ).inc()
-            self._finish_dropped(dropped, reason)
-            logger.debug(
-                "event shard shed a message from pod %s (%s)",
-                dropped.pod_identifier,
-                reason,
+
+    def _predecode(self, message: Message) -> None:
+        """Lock-free decode stage: runs on the ENQUEUEING thread with
+        no locks held, so workers never parse msgpack and enqueueing
+        threads never hold a lock while parsing."""
+        try:
+            message.decoded = decode_event_batch(message.payload)
+            # The payload is never read again once decoded; dropping it
+            # now releases the zero-copy ZMQ frame instead of pinning
+            # raw msgpack alongside the decoded batch for the whole
+            # queue backlog lifetime.
+            message.payload = b""
+        except EventDecodeError as exc:
+            message.decoded = _DECODE_FAILED
+            logger.warning(
+                "dropping poison-pill message from pod %s (topic %s): %s",
+                message.pod_identifier,
+                message.topic,
+                exc,
             )
-        if depth >= 0:
-            METRICS.kvevents_pod_backlog.labels(
-                pod=safe_label(message.pod_identifier)
-            ).set(depth)
+            if message.trace is not None:
+                message.trace.set_error(f"poison pill: {exc}")
+        except Exception as exc:  # noqa: BLE001 — decoder bug, not fatal
+            message.decoded = _DECODE_FAILED
+            logger.exception(
+                "pre-decode failed for a message from pod %s; dropping",
+                message.pod_identifier,
+            )
+            if message.trace is not None:
+                message.trace.set_error(f"pre-decode crashed: {exc!r}")
+
+    def add_task(self, message: Message) -> None:
+        self.add_tasks((message,))
+
+    def add_tasks(self, messages: Sequence[Message]) -> None:
+        """Batched enqueue — the consolidated poller's sink.
+
+        One shard-lock round trip per touched shard per call (vs one
+        per message), metrics and trace bookkeeping batched outside
+        every lock.  The lock-free decode stage runs here when enabled
+        (``PoolConfig.lockfree_decode``): payloads are parsed on this
+        thread with no locks held, and workers apply pre-decoded
+        batches.
+        """
+        if not messages:
+            return
+        per_shard: Dict[int, List[Message]] = {}
+        # Trace start BEFORE pre-decode: a poison pill found at decode
+        # must still error its sampled trace for the flight recorder.
+        for message in messages:
+            self._prepare_message(message)
+            per_shard.setdefault(
+                self._shard_index(message.pod_identifier), []
+            ).append(message)
+        if self._lockfree_decode:
+            t0 = time.perf_counter()
+            n_decoded = 0
+            for message in messages:
+                if message.resync is None and message.decoded is None:
+                    self._predecode(message)
+                    n_decoded += 1
+            if n_decoded:
+                self._stage_account(
+                    "decode", time.perf_counter() - t0, n_decoded
+                )
+        for shard, batch in per_shard.items():
+            shed, depths = self._queues[shard].put_batch(batch)
+            # Metrics + trace finishing OUTSIDE the shard lock.
+            for dropped, reason in shed:
+                METRICS.kvevents_dropped.labels(reason=reason).inc()
+                self._shed_counter(dropped.pod_identifier).inc()
+                self._finish_dropped(dropped, reason)
+                logger.debug(
+                    "event shard shed a message from pod %s (%s)",
+                    dropped.pod_identifier,
+                    reason,
+                )
+            for pod, depth in depths.items():
+                self._backlog_gauge(pod).set(depth)
 
     def enqueue_resync(self, job: ResyncJob, trace_: Optional[Trace] = None):
         """Queue an anti-entropy repair in the pod's shard lane (so it
@@ -673,17 +936,21 @@ class Pool:
     def _worker(self, worker_index: int) -> None:
         q = self._queues[worker_index]
         batch_limit = max(1, self.config.apply_batch_size)
+        # Per-worker digest memo: no cross-thread sharing, no lock —
+        # a worker owns its pods (pod -> shard affinity), so its memo
+        # naturally concentrates on the chains those pods re-store.
+        memo: Optional[OrderedDict] = (
+            OrderedDict() if self._digest_memo_size else None
+        )
         while True:
             batch, closed, depths = q.get_batch(batch_limit)
             if closed:
                 return
             for pod, depth in depths.items():
                 if pod:
-                    METRICS.kvevents_pod_backlog.labels(
-                        pod=safe_label(pod)
-                    ).set(depth)
+                    self._backlog_gauge(pod).set(depth)
             try:
-                self._process_batch(batch, worker_index)
+                self._process_batch(batch, worker_index, memo)
             except Exception:
                 # The batch loop guards decode and apply per message,
                 # but the worker must survive ANYTHING escaping
@@ -702,11 +969,16 @@ class Pool:
                 q.task_done(len(batch))
 
     def _process_batch(
-        self, batch: List[Message], worker_index: int
+        self,
+        batch: List[Message],
+        worker_index: int,
+        memo: Optional[OrderedDict] = None,
     ) -> None:
         METRICS.kvevents_batch_size.observe(len(batch))
         applier = _BatchApplier(self._index, self._journal)
         decoded: List[Optional[EventBatch]] = []
+        decode_t = 0.0
+        decode_n = 0
         for message in batch:
             tr = message.trace
             if tr is not None:
@@ -719,9 +991,21 @@ class Pool:
             if message.resync is not None:
                 decoded.append(None)
                 continue
+            if message.decoded is not None:
+                # Pre-decoded by the lock-free stage (poison pills were
+                # already counted and their traces errored there).
+                decoded.append(
+                    None
+                    if message.decoded is _DECODE_FAILED
+                    else message.decoded
+                )
+                continue
             try:
+                t0 = time.perf_counter()
                 with use_trace(tr):
                     decoded.append(self._decode_message(message))
+                decode_t += time.perf_counter() - t0
+                decode_n += 1
             except Exception:
                 logger.exception(
                     "event worker %d failed decoding a message; dropping",
@@ -730,19 +1014,23 @@ class Pool:
                 decoded.append(None)
                 if tr is not None:
                     tr.finish("error")
+        if decode_n:
+            self._stage_account("decode", decode_t, decode_n)
         # Traces of successfully-digested messages stay open until the
         # final flush lands: their adds may still be deferred in the
         # applier, and a trace that reported "ok" before its admissions
         # were applied would hide a flush failure from the flight
         # recorder.
         pending_traces: List[Trace] = []
+        apply_t0 = time.perf_counter()
+        apply_n = 0
         for message, events in zip(batch, decoded):
             tr = message.trace
             if message.resync is not None:
                 # Barrier like evictions: the purge must not reorder
                 # ahead of admissions digested earlier in this batch.
                 applier.flush()
-                self._apply_resync(message, worker_index)
+                self._apply_resync(message, worker_index, memo)
                 continue
             if events is None:
                 if tr is not None:
@@ -752,7 +1040,8 @@ class Pool:
                 continue
             try:
                 with use_trace(tr):
-                    self._apply_events(message, events, applier)
+                    self._apply_events(message, events, applier, memo)
+                apply_n += 1
             except Exception as exc:
                 if tr is not None:
                     tr.set_error(repr(exc))
@@ -772,6 +1061,10 @@ class Pool:
                 "dropping the batch's deferred admissions",
                 worker_index,
             )
+        if apply_n:
+            self._stage_account(
+                "apply", time.perf_counter() - apply_t0, apply_n
+            )
         # The applier already finished the traces owning any discarded
         # adds as errored (whether the failing flush was this final one
         # or a mid-batch eviction barrier); for everyone else the work
@@ -779,7 +1072,12 @@ class Pool:
         for tr in pending_traces:
             tr.finish()
 
-    def _apply_resync(self, message: Message, worker_index: int) -> None:
+    def _apply_resync(
+        self,
+        message: Message,
+        worker_index: int,
+        memo: Optional[OrderedDict] = None,
+    ) -> None:
         """Purge + re-apply one pod's inventory snapshot, atomically
         with respect to this worker (the pod's only event applier)."""
         job = message.resync
@@ -799,7 +1097,7 @@ class Pool:
                     applier = _BatchApplier(self._index, self._journal)
                     applied = 0
                     for event in job.events:
-                        self._digest(message, event, applier)
+                        self._digest(message, event, applier, memo)
                         applied += 1
                     applier.flush()
                     s.set_attr("purged", purged)
@@ -844,6 +1142,7 @@ class Pool:
         message: Message,
         batch: EventBatch,
         applier: _BatchApplier,
+        memo: Optional[OrderedDict] = None,
     ) -> None:
         with obs_span("kvevents.apply") as s:
             applied = 0
@@ -855,15 +1154,19 @@ class Pool:
                     # the rest of the batch.
                     logger.debug("skipping undecodable event: %s", exc)
                     continue
-                self._digest(message, event, applier)
+                self._digest(message, event, applier, memo)
                 applied += 1
             s.set_attr("applied", applied)
 
     def _digest(
-        self, message: Message, event, applier: _BatchApplier
+        self,
+        message: Message,
+        event,
+        applier: _BatchApplier,
+        memo: Optional[OrderedDict] = None,
     ) -> None:
         if isinstance(event, BlockStored):
-            self._digest_block_stored(message, event, applier)
+            self._digest_block_stored(message, event, applier, memo)
         elif isinstance(event, BlockRemoved):
             self._digest_block_removed(message, event, applier)
         elif isinstance(event, AllBlocksCleared):
@@ -876,7 +1179,11 @@ class Pool:
         return self.config.default_device_tier
 
     def _digest_block_stored(
-        self, message: Message, event: BlockStored, applier: _BatchApplier
+        self,
+        message: Message,
+        event: BlockStored,
+        applier: _BatchApplier,
+        memo: Optional[OrderedDict] = None,
     ) -> None:
         entries = [PodEntry(message.pod_identifier, self._tier(event.medium))]
 
@@ -912,9 +1219,30 @@ class Pool:
                 )
                 return
 
-        request_keys = self._token_processor.tokens_to_kv_block_keys(
-            parent_request_key, event.token_ids, effective_model
-        )
+        # Digest memo: request keys are a pure function of
+        # (parent request key, model, token ids) — the token-processor
+        # identity is fixed per pool — so a repeated chain skips the
+        # hash work entirely.  Values are treated read-only everywhere
+        # downstream (the overlap trim below slices a copy).
+        memo_key = None
+        request_keys = None
+        if memo is not None:
+            memo_key = (
+                parent_request_key,
+                effective_model,
+                tuple(event.token_ids),
+            )
+            request_keys = memo.get(memo_key)
+            if request_keys is not None:
+                memo.move_to_end(memo_key)
+        if request_keys is None:
+            request_keys = self._token_processor.tokens_to_kv_block_keys(
+                parent_request_key, event.token_ids, effective_model
+            )
+            if memo is not None:
+                memo[memo_key] = request_keys
+                if len(memo) > self._digest_memo_size:
+                    memo.popitem(last=False)
         if len(request_keys) != len(engine_keys):
             logger.debug(
                 "engine reported %d hashes but token ids produced %d request "
@@ -953,6 +1281,7 @@ class Pool:
                 logger.debug("skipping bad removal hash %r: %s", raw_hash, exc)
                 continue
             self._index.evict(engine_key, entries)
+            applier.forget_mapping(engine_key)
             evicted_keys.append(engine_key)
         if self._journal is not None and evicted_keys:
             self._journal.record_evict(

@@ -60,8 +60,14 @@ class SubscriberManager:
         pollers: Optional[int] = None,
         poll_interval_ms: Optional[int] = None,
         on_gap: Optional[GapListener] = None,
+        sink_batch: Optional[Callable[[list], None]] = None,
     ) -> None:
         self._sink = sink
+        # Batched delivery (``Pool.add_tasks``): a poller hands each
+        # socket burst to this in ONE call — the write-path fast
+        # lane's enqueue half (docs/event-plane.md).  None keeps
+        # per-message delivery through ``sink``.
+        self._sink_batch = sink_batch
         self._bind = bind
         # Sequence-gap listener plumbed into every channel's demux —
         # the resync manager's mark_suspect in production
@@ -127,6 +133,7 @@ class SubscriberManager:
                 ),
                 self._sink,
                 on_gap=self._on_gap,
+                sink_batch=self._sink_batch,
             )
             self._channels[pod_identifier] = channel
             logger.info(

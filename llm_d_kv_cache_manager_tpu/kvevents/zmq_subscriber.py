@@ -159,6 +159,11 @@ def parse_event_message(
     loss (stale scores for that pod until re-store), so every drop path
     logs at warning with enough context to find the misbehaving
     publisher.  Returns None for malformed frames and duplicate seqs.
+
+    ``payload`` may be any bytes-like object — the poller's zero-copy
+    path passes a ``memoryview`` over the ZMQ frame, which rides the
+    Message untouched into the (pre-)decode stage; topic and seq must
+    be ``bytes`` (they are tiny and always copied out of the frame).
     """
     if len(parts) != 3:
         logger.warning(

@@ -339,9 +339,18 @@ class TestBoundedQueues:
             pool.add_task(self._message(i))
         assert pool._queues[0].qsize() == depth
         assert self._dropped_total() - before == flood - depth
-        # The survivors are the NEWEST messages, still in order.
+        # The survivors are the NEWEST messages, still in order.  With
+        # lock-free pre-decode (the default) the payload was released
+        # at enqueue; the decoded batch rides the message instead.
         queued = pool._queues[0].snapshot()
-        timestamps = [decode_event_batch(m.payload).ts for m in queued]
+        timestamps = [
+            (
+                m.decoded
+                if m.decoded is not None
+                else decode_event_batch(m.payload)
+            ).ts
+            for m in queued
+        ]
         assert timestamps == [float(i) for i in range(flood - depth, flood)]
         # Draining after start processes exactly the survivors.
         pool.start()

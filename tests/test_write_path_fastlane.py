@@ -1,0 +1,864 @@
+"""Write-path fast-lane parity + property tests (docs/event-plane.md).
+
+The fast lane's three accelerators each keep a straight path as a
+parity oracle, and these tests pin the equivalences:
+
+* lock-free pre-decode (``KVEVENTS_LOCKFREE_DECODE``) ≡ straight
+  in-worker decode — same index state and same per-pod journal record
+  streams under an 8-thread mixed add/evict/poison/resync storm;
+* publisher-side coalescing (``KVEVENTS_COALESCE_EVENTS``) ≡ the
+  uncoalesced stream — same index state, same journal records, same
+  seq/gap/restart classification, fewer wire messages, contiguous
+  seqs;
+* the per-worker digest memo (``KVEVENTS_DIGEST_MEMO``) ≡ memoless
+  hashing (request keys are pure functions of parent+model+tokens);
+* the O(1) shed-victim pick (depth buckets) always sheds a pod whose
+  lane is the longest — the same fairness contract the old O(lanes)
+  ``max`` scan enforced;
+* batched enqueue (``Pool.add_tasks``) ≡ message-at-a-time
+  ``add_task``.
+
+Plus the replica-local ingestion slicer: deterministic disjoint/
+complete pod partition, ring-bump re-slice with takeover resync, and
+the membership listener wiring.
+"""
+
+import random
+import struct
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.cluster.ingest import (
+    ReplicaIngestor,
+    pod_owner,
+    slice_pods,
+)
+from llm_d_kv_cache_manager_tpu.cluster.membership import (
+    ClusterMembership,
+)
+from llm_d_kv_cache_manager_tpu.cluster.ring import HashRing
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    Message,
+    Pool,
+    PoolConfig,
+    ResyncJob,
+    _ShardQueue,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher
+from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+    TopicSeqTracker,
+    parse_event_message,
+)
+
+MODEL = "m"
+BLOCK = 4
+
+
+class RecordingJournal:
+    """Journal double capturing applied-op records (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.records = []
+
+    def record_add(self, pod, seq, engine_keys, request_keys, entries):
+        with self._lock:
+            self.records.append(
+                (
+                    "add",
+                    pod,
+                    tuple(engine_keys),
+                    tuple(request_keys),
+                    tuple(
+                        (e.pod_identifier, e.device_tier) for e in entries
+                    ),
+                )
+            )
+
+    def record_evict(self, pod, seq, engine_keys, entries):
+        with self._lock:
+            self.records.append(("evict", pod, tuple(engine_keys)))
+
+    def record_purge(self, pod, seq=0):
+        with self._lock:
+            self.records.append(("purge", pod))
+
+    def per_pod(self, pod):
+        with self._lock:
+            return [r for r in self.records if r[1] == pod]
+
+
+def make_pool(journal=None, **cfg):
+    index = InMemoryIndex(InMemoryIndexConfig(size=100_000))
+    db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=BLOCK))
+    pool = Pool(index, db, PoolConfig(**cfg), journal=journal)
+    return pool, index
+
+
+def pod_stream(rng, pod, n_events, token_offset=0):
+    """A valid per-pod event stream: chained BlockStored runs with
+    interleaved removals and the occasional poison payload, as
+    ``[(payload_bytes, kind), ...]``.
+
+    ``token_offset`` keeps token (and therefore request-key) spaces
+    DISJOINT across pods: a request key shared by two pods makes the
+    engine-mapping cleanup order depend on cross-pod thread
+    scheduling — inherent PUB/SUB raciness that would poison a parity
+    oracle comparing two separately-scheduled runs."""
+    messages = []
+    base = rng.randrange(1, 1 << 20) * 1000
+    chain_tail = None
+    stored = []
+    for i in range(n_events):
+        roll = rng.random()
+        if roll < 0.08:
+            messages.append((b"\x01garbage", "poison"))
+            continue
+        if roll < 0.25 and stored:
+            victim = stored.pop(rng.randrange(len(stored)))
+            event = BlockRemoved(block_hashes=[victim])
+            if victim == chain_tail:
+                chain_tail = None
+            messages.append(
+                (EventBatch(ts=0.0, events=[event]).encode(), "removed")
+            )
+            continue
+        n_blocks = rng.randrange(1, 3)
+        hashes = [base + 10 * i + j for j in range(n_blocks)]
+        tokens = [
+            (base + 17 * i + j) % 30000 + 1 + token_offset
+            for j in range(BLOCK * n_blocks)
+        ]
+        event = BlockStored(
+            block_hashes=hashes,
+            parent_block_hash=chain_tail if rng.random() < 0.5 else None,
+            token_ids=tokens,
+            block_size=BLOCK,
+            medium=rng.choice([None, "hbm", "host"]),
+        )
+        chain_tail = hashes[-1]
+        stored.extend(hashes)
+        messages.append(
+            (EventBatch(ts=0.0, events=[event]).encode(), "stored")
+        )
+    return messages
+
+
+def run_storm(pool, journal, streams, resync_for=None, threads=8):
+    """Drive per-pod streams from ``threads`` worker threads (each
+    thread owns whole pods, preserving per-pod publish order), with an
+    optional mid-stream resync command per pod."""
+    pods = sorted(streams)
+    pool.start()
+    done_events = []
+
+    def run_pod(pod, messages):
+        for i, (payload, _kind) in enumerate(messages):
+            pool.add_task(
+                Message(
+                    topic=f"kv@{pod}@{MODEL}",
+                    payload=payload,
+                    pod_identifier=pod,
+                    model_name=MODEL,
+                    seq=i + 1,
+                )
+            )
+            if resync_for and pod in resync_for and i == len(messages) // 2:
+                job, done = resync_for[pod]()
+                done_events.append(done)
+                pool.enqueue_resync(job)
+
+    def worker(worker_pods):
+        for pod in worker_pods:
+            run_pod(pod, streams[pod])
+
+    thread_objs = [
+        threading.Thread(target=worker, args=(pods[t::threads],))
+        for t in range(threads)
+    ]
+    for t in thread_objs:
+        t.start()
+    for t in thread_objs:
+        t.join()
+    pool.drain()
+    for done in done_events:
+        assert done.wait(10), "resync job never reported"
+    pool.shutdown()
+
+
+def index_state(index):
+    block_entries, engine_map = index.dump_entries()
+    return (
+        sorted(
+            (key, tuple(sorted((e.pod_identifier, e.device_tier) for e in entries)))
+            for key, entries in block_entries
+        ),
+        sorted(engine_map),
+    )
+
+
+class TestLockfreeDecodeParity:
+    @pytest.mark.parametrize("seed", [1, 7])
+    def test_storm_parity_lockfree_vs_straight(self, seed):
+        rng = random.Random(seed)
+        pods = [f"storm-{i}" for i in range(16)]
+        streams = {
+            pod: pod_stream(rng, pod, 40, token_offset=30000 * i)
+            for i, pod in enumerate(pods)
+        }
+
+        # A mid-stream resync for a quarter of the pods: purge + a
+        # fixed one-block inventory, identical on both sides.  The
+        # inventory hash is pod-unique and deterministic — a shared or
+        # seed-dependent key would make cross-pod outcomes depend on
+        # thread scheduling and poison the parity oracle.
+        def resync_factory(pod):
+            pod_index = int(pod.rsplit("-", 1)[1])
+
+            def build():
+                done = threading.Event()
+                job = ResyncJob(
+                    pod_identifier=pod,
+                    model_name=MODEL,
+                    events=[
+                        BlockStored(
+                            block_hashes=[99_000_000 + pod_index],
+                            parent_block_hash=None,
+                            # Pod-unique token chain (same reason as
+                            # pod_stream's token_offset): a request
+                            # key shared across pods races cross-pod.
+                            token_ids=[
+                                1_000_000 + pod_index * BLOCK + j
+                                for j in range(1, BLOCK + 1)
+                            ],
+                            block_size=BLOCK,
+                        )
+                    ],
+                    on_done=lambda j, ok, purged, detail: done.set(),
+                )
+                return job, done
+
+            return build
+
+        resync_for = {pod: resync_factory(pod) for pod in pods[::4]}
+
+        states = {}
+        journals = {}
+        for mode, cfg in (
+            ("straight", dict(lockfree_decode=False, digest_memo=0)),
+            ("lockfree", dict(lockfree_decode=True, digest_memo=64)),
+        ):
+            journal = RecordingJournal()
+            pool, index = make_pool(journal=journal, concurrency=4, **cfg)
+            run_storm(pool, journal, streams, resync_for=resync_for)
+            states[mode] = index_state(index)
+            journals[mode] = journal
+        assert states["straight"] == states["lockfree"]
+        for pod in pods:
+            assert journals["straight"].per_pod(pod) == journals[
+                "lockfree"
+            ].per_pod(pod), f"journal drift for {pod}"
+
+    def test_predecode_marks_poison_and_worker_skips(self):
+        pool, index = make_pool(concurrency=1, lockfree_decode=True)
+        message = Message(
+            topic=f"kv@p@{MODEL}",
+            payload=b"\x01garbage",
+            pod_identifier="p",
+            model_name=MODEL,
+        )
+        pool.start()
+        pool.add_tasks([message])
+        pool.drain()
+        pool.shutdown()
+        assert message.decoded is not None  # the failure sentinel
+        assert index.dump_entries() == ([], [])
+
+    def test_predecode_happens_before_queue(self):
+        pool, _index = make_pool(concurrency=1, lockfree_decode=True)
+        payload = EventBatch(
+            ts=0.0,
+            events=[
+                BlockStored(
+                    block_hashes=[1],
+                    parent_block_hash=None,
+                    token_ids=list(range(1, BLOCK + 1)),
+                    block_size=BLOCK,
+                )
+            ],
+        ).encode()
+        message = Message(
+            topic=f"kv@p@{MODEL}",
+            payload=payload,
+            pod_identifier="p",
+            model_name=MODEL,
+        )
+        # Pool not started: workers cannot have decoded it.
+        pool.add_tasks([message])
+        assert isinstance(message.decoded, EventBatch)
+        stats = pool.stage_stats()
+        assert stats["decode_msgs"] == 1 and stats["apply_msgs"] == 0
+        pool.start()
+        pool.drain()
+        assert pool.stage_stats()["apply_msgs"] == 1
+        pool.shutdown()
+
+    def test_memoryview_payload_decodes(self):
+        pool, index = make_pool(concurrency=1, lockfree_decode=True)
+        payload = EventBatch(
+            ts=0.0,
+            events=[
+                BlockStored(
+                    block_hashes=[5],
+                    parent_block_hash=None,
+                    token_ids=list(range(1, BLOCK + 1)),
+                    block_size=BLOCK,
+                )
+            ],
+        ).encode()
+        pool.start()
+        pool.add_tasks(
+            [
+                Message(
+                    topic=f"kv@p@{MODEL}",
+                    payload=memoryview(payload),
+                    pod_identifier="p",
+                    model_name=MODEL,
+                )
+            ]
+        )
+        pool.drain()
+        pool.shutdown()
+        block_entries, engine_map = index.dump_entries()
+        assert len(block_entries) == 1 and len(engine_map) == 1
+
+
+class TestDigestMemoParity:
+    def test_repeated_chains_identical_state(self):
+        rng = random.Random(3)
+        pods = [f"memo-{i}" for i in range(6)]
+        # Heavy repetition WITHIN each pod (its own stream replayed
+        # three times): memo hits without cross-pod key sharing —
+        # shared engine keys would make evict/store interleaving
+        # across pods schedule-dependent and break the oracle.
+        streams = {}
+        for i, pod in enumerate(pods):
+            stream = pod_stream(rng, pod, 20, token_offset=30000 * i)
+            streams[pod] = stream * 3
+        states = {}
+        for mode, cfg in (
+            ("memo", dict(digest_memo=32)),
+            ("memoless", dict(digest_memo=0)),
+        ):
+            pool, index = make_pool(concurrency=2, **cfg)
+            run_storm(pool, None, streams, threads=3)
+            states[mode] = index_state(index)
+        assert states["memo"] == states["memoless"]
+
+
+class TestShedVictimProperty:
+    def test_overflow_always_sheds_a_longest_lane(self):
+        rng = random.Random(11)
+        q = _ShardQueue(max_depth=32, pod_budget=1000, per_pod=True)
+        pods = [f"s{i}" for i in range(9)]
+        for step in range(3000):
+            pod = rng.choice(pods)
+            depths_before = q.lane_depths()
+            shed, _depth = q.put(
+                Message(
+                    topic="t",
+                    payload=b"",
+                    pod_identifier=pod,
+                    model_name=MODEL,
+                    seq=step,
+                )
+            )
+            for victim, reason in shed:
+                assert reason == "queue_full"
+                assert depths_before[victim.pod_identifier] == max(
+                    depths_before.values()
+                )
+            if rng.random() < 0.2:
+                batch, _closed, _depths = q.get_batch(rng.randrange(1, 8))
+                assert batch
+        # Buckets stay consistent with the depth map throughout.
+        depths = q.lane_depths()
+        assert sum(depths.values()) == q.qsize()
+
+    def test_budget_shed_still_self_targets(self):
+        q = _ShardQueue(max_depth=1000, pod_budget=3, per_pod=True)
+        for i in range(10):
+            shed, _ = q.put(
+                Message(
+                    topic="t",
+                    payload=b"",
+                    pod_identifier="greedy",
+                    model_name=MODEL,
+                    seq=i,
+                )
+            )
+            for victim, reason in shed:
+                assert reason == "pod_budget"
+                assert victim.pod_identifier == "greedy"
+        assert q.lane_depths() == {"greedy": 3}
+
+
+class TestBatchedEnqueue:
+    def test_add_tasks_equivalent_to_add_task(self):
+        rng = random.Random(5)
+        pods = [f"b{i}" for i in range(8)]
+        streams = {
+            pod: pod_stream(rng, pod, 25, token_offset=30000 * i)
+            for i, pod in enumerate(pods)
+        }
+        states = {}
+        for mode in ("single", "batched"):
+            pool, index = make_pool(concurrency=2, lockfree_decode=True)
+            pool.start()
+            if mode == "single":
+                for pod, stream in streams.items():
+                    for i, (payload, _kind) in enumerate(stream):
+                        pool.add_task(
+                            Message(
+                                topic=f"kv@{pod}@{MODEL}",
+                                payload=payload,
+                                pod_identifier=pod,
+                                model_name=MODEL,
+                                seq=i,
+                            )
+                        )
+            else:
+                burst = []
+                for pod, stream in streams.items():
+                    for i, (payload, _kind) in enumerate(stream):
+                        burst.append(
+                            Message(
+                                topic=f"kv@{pod}@{MODEL}",
+                                payload=payload,
+                                pod_identifier=pod,
+                                model_name=MODEL,
+                                seq=i,
+                            )
+                        )
+                        if len(burst) == 16:
+                            pool.add_tasks(burst)
+                            burst = []
+                pool.add_tasks(burst)
+            pool.drain()
+            pool.shutdown()
+            states[mode] = index_state(index)
+        assert states["single"] == states["batched"]
+
+    def test_put_batch_shutdown_rejects_all(self):
+        q = _ShardQueue(max_depth=8, pod_budget=8, per_pod=True)
+        q.close()
+        msgs = [
+            Message(
+                topic="t",
+                payload=b"",
+                pod_identifier=f"p{i}",
+                model_name=MODEL,
+            )
+            for i in range(3)
+        ]
+        shed, depths = q.put_batch(msgs)
+        assert depths == {}
+        assert [reason for _m, reason in shed] == ["shutdown"] * 3
+
+
+def drain_sub(sock, tracker, pod, limit=10_000):
+    """Drain everything currently queued on an inproc SUB socket."""
+    import zmq
+
+    out = []
+    for _ in range(limit):
+        try:
+            parts = sock.recv_multipart(zmq.NOBLOCK)
+        except zmq.Again:
+            break
+        message = parse_event_message(
+            parts, endpoint="e", pod_identifier=pod, tracker=tracker
+        )
+        if message is not None:
+            out.append(message)
+    return out
+
+
+class TestPublisherCoalescing:
+    def _publish_stream(self, coalesce_events, events_per_call, seed=9):
+        import zmq
+
+        context = zmq.Context.instance()
+        pod = f"co-{coalesce_events}-{seed}"
+        pub = Publisher(
+            "inproc://" + pod,
+            pod,
+            MODEL,
+            context=context,
+            coalesce_events=coalesce_events,
+            coalesce_ms=60_000.0,  # only size/flush triggers in tests
+        )
+        sub = context.socket(zmq.SUB)
+        sub.setsockopt(zmq.LINGER, 0)
+        sub.setsockopt(zmq.SUBSCRIBE, b"")
+        sub.connect("inproc://" + pod)
+        import time as _time
+
+        _time.sleep(0.05)  # inproc join
+        rng = random.Random(seed)
+        # Random event objects (valid chains within one publisher).
+        events = []
+        chain_tail = None
+        base = 5000
+        for i in range(40):
+            if rng.random() < 0.25 and events:
+                events.append(BlockRemoved(block_hashes=[base + i - 1]))
+                continue
+            stored = BlockStored(
+                block_hashes=[base + i],
+                parent_block_hash=chain_tail,
+                token_ids=[
+                    (base + i * 7 + j) % 3000 + 1 for j in range(BLOCK)
+                ],
+                block_size=BLOCK,
+            )
+            chain_tail = base + i
+            events.append(stored)
+        calls = []
+        i = 0
+        while i < len(events):
+            n = min(events_per_call, len(events) - i)
+            calls.append(events[i : i + n])
+            i += n
+        # A forced seq skip mid-stream must classify identically.
+        for j, call in enumerate(calls):
+            if j == len(calls) // 2:
+                pub.flush()
+                pub.advance_seq(3)
+            pub.publish(*call)
+        pub.flush()
+        tracker = TopicSeqTracker()
+        messages = drain_sub(sub, tracker, pod)
+        pub.close()
+        sub.close()
+        return messages, tracker, events
+
+    def apply_messages(self, messages, journal):
+        pool, index = make_pool(journal=journal, concurrency=1)
+        pool.start()
+        pool.add_tasks(messages)
+        pool.drain()
+        pool.shutdown()
+        return index_state(index)
+
+    def test_coalesced_equals_uncoalesced(self):
+        plain_msgs, plain_tracker, plain_events = self._publish_stream(
+            coalesce_events=0, events_per_call=1
+        )
+        co_msgs, co_tracker, co_events = self._publish_stream(
+            coalesce_events=8, events_per_call=1
+        )
+        assert [e.to_tagged_union() for e in plain_events] == [
+            e.to_tagged_union() for e in co_events
+        ]
+        # Fewer wire messages, same events, same gap classification.
+        assert len(co_msgs) < len(plain_msgs)
+        assert plain_tracker.gap_count == co_tracker.gap_count == 3
+        assert plain_tracker.restart_count == co_tracker.restart_count == 0
+
+        plain_journal = RecordingJournal()
+        co_journal = RecordingJournal()
+        plain_state = self.apply_messages(plain_msgs, plain_journal)
+        # The coalesced pod id differs; rewrite pod identity so both
+        # streams index the same pod.
+        pod = plain_msgs[0].pod_identifier
+        for message in co_msgs:
+            message.pod_identifier = pod
+            message.topic = plain_msgs[0].topic
+        co_state = self.apply_messages(co_msgs, co_journal)
+        assert plain_state == co_state
+        assert [
+            (op, keys) for op, _pod, keys, *rest in plain_journal.records
+        ] == [(op, keys) for op, _pod, keys, *rest in co_journal.records]
+
+    def test_buffered_publish_returns_none_then_flush_seq(self):
+        import zmq
+
+        pub = Publisher(
+            "inproc://co-flush",
+            "co-flush",
+            MODEL,
+            context=zmq.Context.instance(),
+            coalesce_events=10,
+            coalesce_ms=60_000.0,
+        )
+        stored = BlockStored(
+            block_hashes=[1],
+            parent_block_hash=None,
+            token_ids=[1, 2, 3, 4],
+            block_size=BLOCK,
+        )
+        assert pub.publish(stored) is None
+        assert pub.publish(stored) is None
+        seq = pub.flush()
+        assert seq == 1
+        assert pub.flush() is None
+        # Size trigger: the 10th event flushes inline.
+        seqs = [pub.publish(stored) for _ in range(10)]
+        assert seqs[:-1] == [None] * 9 and seqs[-1] == 2
+        pub.close()
+
+    def test_close_flushes_buffer(self):
+        import zmq
+
+        context = zmq.Context.instance()
+        pub = Publisher(
+            "inproc://co-close",
+            "co-close",
+            MODEL,
+            context=context,
+            coalesce_events=100,
+            coalesce_ms=60_000.0,
+        )
+        sub = context.socket(zmq.SUB)
+        sub.setsockopt(zmq.LINGER, 0)
+        sub.setsockopt(zmq.SUBSCRIBE, b"")
+        sub.connect("inproc://co-close")
+        import time as _time
+
+        _time.sleep(0.05)
+        stored = BlockStored(
+            block_hashes=[1],
+            parent_block_hash=None,
+            token_ids=[1, 2, 3, 4],
+            block_size=BLOCK,
+        )
+        assert pub.publish(stored) is None
+        pub.close()
+        messages = drain_sub(sub, TopicSeqTracker(), "co-close")
+        sub.close()
+        assert len(messages) == 1
+
+    def test_concurrent_coalesced_publish_keeps_seqs_ordered(self):
+        import zmq
+
+        context = zmq.Context.instance()
+        pub = Publisher(
+            "inproc://co-mt",
+            "co-mt",
+            MODEL,
+            context=context,
+            coalesce_events=4,
+            coalesce_ms=60_000.0,
+        )
+        sub = context.socket(zmq.SUB)
+        sub.setsockopt(zmq.LINGER, 0)
+        sub.setsockopt(zmq.SUBSCRIBE, b"")
+        sub.connect("inproc://co-mt")
+        import time as _time
+
+        _time.sleep(0.05)
+        stored = BlockStored(
+            block_hashes=[1],
+            parent_block_hash=None,
+            token_ids=[1, 2, 3, 4],
+            block_size=BLOCK,
+        )
+
+        def spam():
+            for _ in range(100):
+                pub.publish(stored)
+
+        threads = [threading.Thread(target=spam) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        pub.flush()
+        _time.sleep(0.05)
+        seqs = []
+        for _ in range(10_000):
+            try:
+                parts = sub.recv_multipart(zmq.NOBLOCK)
+            except zmq.Again:
+                break
+            seqs.append(struct.unpack(">Q", parts[1])[0])
+        pub.close()
+        sub.close()
+        assert seqs == sorted(seqs)
+        assert len(set(seqs)) == len(seqs)
+        # 400 events in batches of 4 -> 100 wire messages (+ remainder).
+        assert seqs and seqs[-1] == len(seqs)
+
+
+class FakeManager:
+    def __init__(self):
+        self.active = {}
+        self.calls = []
+
+    def ensure_subscriber(self, pod, endpoint, topic_filter=None):
+        fresh = self.active.get(pod) != (endpoint, topic_filter)
+        self.active[pod] = (endpoint, topic_filter)
+        self.calls.append(("ensure", pod))
+        return fresh
+
+    def remove_subscriber(self, pod):
+        self.calls.append(("remove", pod))
+        return self.active.pop(pod, None) is not None
+
+
+class FakeResync:
+    def __init__(self):
+        self.requested = []
+
+    def request_resync(self, pod, model_name=""):
+        self.requested.append(pod)
+        return True
+
+
+class TestReplicaIngestor:
+    def test_partition_is_disjoint_and_complete(self):
+        ring = HashRing(["r0", "r1", "r2"])
+        pods = [f"pod-{i}" for i in range(60)]
+        slices = {
+            r: set(slice_pods(ring, r, pods)) for r in ring.members
+        }
+        union = set().union(*slices.values())
+        assert union == set(pods)
+        total = sum(len(s) for s in slices.values())
+        assert total == len(pods)
+        # Deterministic across calls and consistent with pod_owner.
+        for r, owned in slices.items():
+            for pod in owned:
+                assert pod_owner(ring, pod) == r
+
+    def test_subscribes_only_owned_slice(self):
+        ring = HashRing(["r0", "r1", "r2"])
+        pods = [f"pod-{i}" for i in range(30)]
+        manager = FakeManager()
+        ingestor = ReplicaIngestor("r0", manager, ring=ring)
+        for pod in pods:
+            ingestor.ensure_subscriber(pod, f"tcp://{pod}:5557")
+        assert set(manager.active) == set(slice_pods(ring, "r0", pods))
+        assert ingestor.known_pods() == sorted(pods)
+        assert ingestor.owned_pods() == sorted(manager.active)
+
+    def test_ring_bump_takes_over_and_resyncs(self):
+        ring = HashRing(["r0", "r1", "r2"])
+        pods = [f"pod-{i}" for i in range(40)]
+        manager = FakeManager()
+        resync = FakeResync()
+        ingestor = ReplicaIngestor(
+            "r0", manager, ring=ring, resync=resync
+        )
+        for pod in pods:
+            ingestor.ensure_subscriber(pod, f"tcp://{pod}:5557")
+        before = set(manager.active)
+        shrunk = ring.without("r1")
+        ingestor.apply_ring(shrunk)
+        after = set(manager.active)
+        gained = after - before
+        # Exactly r1's pods that now rendezvous to r0, all resynced.
+        expected = {
+            pod
+            for pod in pods
+            if pod_owner(ring, pod) == "r1"
+            and pod_owner(shrunk, pod) == "r0"
+        }
+        assert gained == expected
+        assert set(resync.requested) == expected
+        # Rejoin: the reclaimed pods detach again, no extra resyncs.
+        ingestor.apply_ring(ring.without("r1").with_member("r1"))
+        assert set(manager.active) == before
+        assert set(resync.requested) == expected
+
+    def test_membership_listener_wiring(self):
+        class DummyTransport:
+            def call(self, method, args):
+                return "ok"
+
+        membership = ClusterMembership(
+            {r: DummyTransport() for r in ("r0", "r1", "r2")}
+        )
+        manager = FakeManager()
+        ingestor = ReplicaIngestor(
+            "r0", manager, membership=membership
+        )
+        pods = [f"pod-{i}" for i in range(30)]
+        for pod in pods:
+            ingestor.ensure_subscriber(pod, f"tcp://{pod}:5557")
+        before = set(manager.active)
+        assert membership.mark_dead("r1", "test")
+        assert set(manager.active) >= before
+        assert ingestor.status()["reslices"] == 1
+        assert membership.mark_alive("r1")
+        assert set(manager.active) == before
+        assert ingestor.status()["reslices"] == 2
+
+    def test_stale_ring_notification_ignored(self):
+        # Membership notifies listeners outside its lock, so two
+        # near-simultaneous failovers can deliver rings out of order;
+        # the older ring must not overwrite the newer slicing.
+        ring = HashRing(["r0", "r1", "r2"])
+        manager = FakeManager()
+        ingestor = ReplicaIngestor("r0", manager, ring=ring)
+        pods = [f"pod-{i}" for i in range(30)]
+        for pod in pods:
+            ingestor.ensure_subscriber(pod, "tcp://x:1")
+        newer = ring.without("r1").without("r2")  # v2: r0 owns all
+        ingestor.apply_ring(newer)
+        assert set(manager.active) == set(pods)
+        stale = ring.without("r2")  # v1, delivered late
+        ingestor.apply_ring(stale)
+        assert set(manager.active) == set(pods)
+        assert ingestor.status()["ring_version"] == 2
+        assert ingestor.status()["reslices"] == 1
+
+    def test_active_pods_reports_known_fleet_for_pruning(self):
+        # The reconciler prunes departed pods by diffing active_pods()
+        # against its list response: the ingestor must report the
+        # KNOWN fleet, not just the owned slice, or a departed
+        # unowned pod would be resubscribed as a ghost on takeover.
+        ring = HashRing(["r0", "r1", "r2"])
+        manager = FakeManager()
+        ingestor = ReplicaIngestor("r0", manager, ring=ring)
+        pods = [f"pod-{i}" for i in range(12)]
+        for pod in pods:
+            ingestor.ensure_subscriber(pod, "tcp://x:1")
+        assert ingestor.active_pods() == sorted(pods)
+        gone = pods[0]
+        ingestor.remove_subscriber(gone)
+        assert gone not in ingestor.active_pods()
+        # A later takeover must not resurrect it.
+        ingestor.apply_ring(ring.without("r1"))
+        assert gone not in manager.active
+
+    def test_unowned_pod_rejected_and_stale_channel_dropped(self):
+        ring = HashRing(["r0", "r1"])
+        manager = FakeManager()
+        ingestor = ReplicaIngestor("r0", manager, ring=ring)
+        pods = [f"pod-{i}" for i in range(20)]
+        mine = slice_pods(ring, "r0", pods)
+        other = [p for p in pods if p not in mine]
+        assert other, "need at least one foreign pod"
+        for pod in pods:
+            ingestor.ensure_subscriber(pod, "tcp://x:1")
+        # A re-announce of a foreign pod must not subscribe it.
+        assert ingestor.ensure_subscriber(other[0], "tcp://x:2") is False
+        assert other[0] not in manager.active
