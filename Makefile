@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke perf-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke offload-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke offload-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -61,6 +61,16 @@ examples:
 # /debug/traces retrieval, explain=1, /healthz block.
 obs-smoke:
 	$(PYTHON) hack/verify_observability.py
+
+# Fleet observability smoke (same invocation as CI's "SLO smoke"
+# step): 3 strict-wire replicas behind a router service — a scored
+# request stitches into ONE cross-replica trace (owner cluster.rpc
+# spans + piggybacked replica-side sub-spans, stage sums ±5% of e2e),
+# /debug/slo reports healthy under traffic then flags a bounded
+# degradation when a replica is killed mid-traffic, with the envelope
+# asserted via envelope_violations (docs/observability.md).
+slo-smoke:
+	$(CPU_ENV) $(PYTHON) hack/slo_smoke.py
 
 # Read-path perf smoke (same invocation as CI's "Read-path perf
 # smoke" step): a few seconds of the bench's read_path regime on CPU,

@@ -27,6 +27,7 @@ import json
 import os
 import socket
 import threading
+import time
 import urllib.parse
 from typing import Dict, Optional
 
@@ -55,6 +56,7 @@ def _make_handler(
     tiering=None,
     replica=None,
     cluster_status=None,
+    slo=None,
 ):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -248,6 +250,14 @@ def _make_handler(
                     except Exception:  # noqa: BLE001 — health must answer
                         logger.exception("tiering status failed")
                         health["tiering"] = {"error": "unavailable"}
+                if slo is not None:
+                    # Compact degradation envelope; the full per-SLI
+                    # payload lives at /debug/slo.
+                    try:
+                        health["slo"] = slo.healthz_block()
+                    except Exception:  # noqa: BLE001 — health must answer
+                        logger.exception("slo status failed")
+                        health["slo"] = {"error": "unavailable"}
                 self._reply_json(200, health)
             elif path == "/debug/traces":
                 self._debug_traces(query)
@@ -259,8 +269,26 @@ def _make_handler(
                 self._debug_tiering()
             elif path == "/debug/cluster":
                 self._debug_cluster()
+            elif path == "/debug/slo":
+                self._debug_slo()
             else:
                 self._error(404, "not found")
+
+        def _debug_slo(self):
+            """Read-only degradation envelopes: per-SLI state, burn
+            rates over both evaluation windows, and the declared
+            bounds chaos cells assert against
+            (docs/observability.md)."""
+            if slo is None:
+                self._error(404, "slo engine disabled (SLO_ENABLE=0)")
+                return
+            try:
+                payload = slo.status()
+            except Exception as exc:  # noqa: BLE001 — debug must answer
+                logger.exception("slo status failed")
+                self._error(500, f"error: {exc}")
+                return
+            self._reply_json(200, payload)
 
         def _debug_cluster(self):
             """Read-only cluster plane: membership + ring version +
@@ -524,6 +552,29 @@ def _make_handler(
         def _wants_explain(query) -> bool:
             return query.get("explain", "").lower() in ("1", "true", "yes")
 
+        @staticmethod
+        def _cluster_rpc_rollup(spans) -> Optional[Dict[str, dict]]:
+            """Per-replica rollup of a trace's ``cluster.rpc`` spans —
+            which owner dominated this score (docs/observability.md
+            "Fleet tracing")."""
+            rollup: Dict[str, dict] = {}
+            for view in spans:
+                if view["name"] != "cluster.rpc":
+                    continue
+                replica = str(
+                    view["attributes"].get("replica", "unknown")
+                )
+                entry = rollup.setdefault(
+                    replica, {"rpcs": 0, "total_ms": 0.0, "errors": 0}
+                )
+                entry["rpcs"] += 1
+                entry["total_ms"] = round(
+                    entry["total_ms"] + view["duration_ms"], 3
+                )
+                if view["status"] != "ok":
+                    entry["errors"] += 1
+            return rollup or None
+
         def _run_scored(self, name, query, score_kwargs):
             """Shared scoring execution: trace lifecycle (traceparent
             ingest/echo, ``?explain=1`` forcing a sample), the explain
@@ -535,6 +586,7 @@ def _make_handler(
                 traceparent=self.headers.get("traceparent"),
                 force=explain,
             )
+            started = time.perf_counter()
             try:
                 with use_trace(req_trace):
                     if explain:
@@ -547,12 +599,20 @@ def _make_handler(
                             None,
                         )
             except Exception as exc:
+                # The SLO feeds see FAILED requests too: a fully
+                # failing service must burn the availability SLI, not
+                # read as a no-data latency SLI (obs/slo.py).
+                METRICS.score_latency.observe(
+                    time.perf_counter() - started
+                )
+                METRICS.score_requests.labels(outcome="error").inc()
                 if req_trace is not None:
                     req_trace.set_error(repr(exc))
                     req_trace.finish("error")
                 logger.exception("%s failed", name)
                 self._error(500, f"error: {exc}")
                 return
+            elapsed = time.perf_counter() - started
             headers: Dict[str, str] = {}
             if req_trace is not None:
                 # Finish BEFORE replying so the trace is retrievable
@@ -560,15 +620,25 @@ def _make_handler(
                 # echoed traceparent.
                 req_trace.finish()
                 headers["traceparent"] = req_trace.traceparent()
+            # Every request feeds the SLO latency/availability SLIs —
+            # unsampled, unlike the trace-fed stage histogram
+            # (obs/slo.py); the observations sit outside the trace
+            # window so they cannot widen the stage-sum gap the
+            # acceptance tests pin.
+            METRICS.score_latency.observe(elapsed)
+            METRICS.score_requests.labels(outcome="ok").inc()
             if not explain:
                 self._reply_json(200, scores, headers)
                 return
             # explain forces sampling, so req_trace is always live here.
-            trace_view = req_trace.to_dict(include_spans=False)
+            trace_view = req_trace.to_dict(include_spans=True)
             detail = dict(detail)
             detail["trace_id"] = req_trace.trace_id
             detail["duration_ms"] = trace_view["duration_ms"]
             detail["stages"] = trace_view["stages"]
+            cluster_rpcs = self._cluster_rpc_rollup(trace_view["spans"])
+            if cluster_rpcs is not None:
+                detail["cluster_rpcs"] = cluster_rpcs
             self._reply_json(
                 200, {"scores": scores, "explain": detail}, headers
             )
@@ -640,6 +710,7 @@ def serve(
     tiering=None,
     replica=None,
     cluster_status=None,
+    slo=None,
 ) -> http.server.ThreadingHTTPServer:
     """Start the HTTP service on a background thread; returns the server
     (call ``.shutdown()`` to stop).  ``admin_token`` (env:
@@ -656,7 +727,10 @@ def serve(
     backs ``GET /debug/tiering`` and the ``/healthz`` tiering block;
     ``replica`` (a ``cluster.ClusterReplica``) serves the
     ``POST /replica`` RPC surface and ``cluster_status`` (a zero-arg
-    callable) backs ``GET /debug/cluster`` (docs/replication.md)."""
+    callable) backs ``GET /debug/cluster`` (docs/replication.md);
+    ``slo`` (an ``obs.slo.SloEngine``) backs ``GET /debug/slo`` and
+    the ``/healthz`` degradation-envelope block
+    (docs/observability.md)."""
     server = http.server.ThreadingHTTPServer(
         (host, port),
         _make_handler(
@@ -669,6 +743,7 @@ def serve(
             tiering=tiering,
             replica=replica,
             cluster_status=cluster_status,
+            slo=slo,
         ),
     )
     thread = threading.Thread(
@@ -749,6 +824,7 @@ def main() -> None:  # pragma: no cover - CLI entry
     # unchanged against the remote backend.
     cluster_membership = None
     cluster_heartbeat = None
+    cluster_remote_index = None
     injected_index = None
     if os.environ.get("CLUSTER_REPLICAS"):
         from llm_d_kv_cache_manager_tpu.cluster import (
@@ -778,7 +854,8 @@ def main() -> None:  # pragma: no cover - CLI entry
             misses=int(os.environ.get("CLUSTER_HEARTBEAT_MISSES", "2")),
         )
         cluster_heartbeat.start()
-        injected_index = RemoteIndex(cluster_membership)
+        cluster_remote_index = RemoteIndex(cluster_membership)
+        injected_index = cluster_remote_index
         if config.kvblock_index_config.enable_metrics:
             from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import (  # noqa: E501 - lazy: mirrors new_index's wrap
                 InstrumentedIndex,
@@ -866,6 +943,10 @@ def main() -> None:  # pragma: no cover - CLI entry
             }
             if cluster_membership is not None:
                 status["membership"] = cluster_membership.status()
+            if cluster_remote_index is not None:
+                # Per-replica fan-out attribution + the sequential
+                # critical-path breakdown (docs/observability.md).
+                status["rpc"] = cluster_remote_index.rpc_stats()
             if cluster_replica is not None:
                 status["replica"] = cluster_replica.replica_id
             if cluster_followers:
@@ -1060,6 +1141,38 @@ def main() -> None:  # pragma: no cover - CLI entry
         float(os.environ.get("METRICS_LOGGING_INTERVAL", "60"))
     )
 
+    # SLO_ENABLE (default on) attaches the degradation-envelope engine
+    # (obs/slo.py): the stock fleet SLIs are fed from existing metric
+    # surfaces, evaluated over a fast and a slow window, and published
+    # at GET /debug/slo + the /healthz slo block.
+    slo_engine = None
+    if os.environ.get("SLO_ENABLE", "1").lower() not in (
+        "0",
+        "false",
+        "off",
+        "no",
+    ):
+        from llm_d_kv_cache_manager_tpu.obs.slo import default_fleet_slos
+
+        slo_engine = default_fleet_slos(
+            window_fast_s=float(
+                os.environ.get("SLO_WINDOW_FAST_S", "300")
+            ),
+            window_slow_s=float(
+                os.environ.get("SLO_WINDOW_SLOW_S", "3600")
+            ),
+            score_latency_s=(
+                float(os.environ.get("SLO_SCORE_LATENCY_MS", "250"))
+                / 1000.0
+            ),
+            hit_rate_objective=float(
+                os.environ.get("SLO_HIT_RATE_OBJECTIVE", "0")
+            ),
+            membership=cluster_membership,
+            pool=pool,
+        )
+        slo_engine.start(float(os.environ.get("SLO_POLL_S", "5")))
+
     def event_plane_status() -> dict:
         status = {
             "pollers": manager.poller_count(),
@@ -1082,6 +1195,7 @@ def main() -> None:  # pragma: no cover - CLI entry
         tiering=policy_engine,
         replica=cluster_replica,
         cluster_status=cluster_status,
+        slo=slo_engine,
     )
     try:
         threading.Event().wait()
@@ -1089,6 +1203,8 @@ def main() -> None:  # pragma: no cover - CLI entry
         pass
     finally:
         stop_beat.set()
+        if slo_engine is not None:
+            slo_engine.close()
         if stop_snapshots is not None:
             stop_snapshots.set()
         server.shutdown()

@@ -143,4 +143,5 @@ class LocalCluster:
         return {
             "membership": self.membership.status(),
             "replication": [f.status() for f in self.followers],
+            "rpc": self.remote_index.rpc_stats(),
         }

@@ -37,6 +37,13 @@ Correctness invariants:
   owns its channels' seq trackers, its pool's shard lanes and
   budgets, and its journal tap — the same contracts as the
   single-process plane, replicated N times over disjoint pod sets.
+* **Event traces cross the replica boundary**: a sampled
+  ``kvevents.message`` trace rides the pool worker into the
+  ``RemoteIndex`` apply, whose per-owner RPCs record ``cluster.rpc``
+  spans and stitch the replica-side ``replica.apply`` summaries off
+  the wire — the ingest pipeline's write fan-out is attributable
+  per owner exactly like the read path's
+  (docs/observability.md "Fleet tracing").
 """
 
 from __future__ import annotations

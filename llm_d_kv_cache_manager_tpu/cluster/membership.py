@@ -58,6 +58,10 @@ class ClusterMembership:
         self.full_ring = HashRing(sorted(self._transports))
         self._failover_count = 0  # guarded-by: _lock
         self._last_heartbeat: Dict[str, float] = {}  # guarded-by: _lock
+        # replica -> (unix_ts, reason) of the last mark_dead — the
+        # /debug/cluster "why did it leave the ring" context that
+        # otherwise only existed as a log line.  guarded-by: _lock
+        self._last_errors: Dict[str, Tuple[float, str]] = {}
         # Ring-change listeners (replica-local ingestion re-slices its
         # pod subscriptions on every version bump — cluster/ingest.py).
         # Invoked OUTSIDE the membership lock with the new ring.
@@ -95,6 +99,7 @@ class ClusterMembership:
     def status(self) -> dict:
         """The /debug/cluster membership block."""
         now = time.monotonic()
+        wall = time.time()
         with self._lock:
             return {
                 "members": sorted(self._transports),
@@ -104,6 +109,13 @@ class ClusterMembership:
                 "heartbeat_age_s": {
                     replica: round(now - seen, 3)
                     for replica, seen in self._last_heartbeat.items()
+                },
+                "last_errors": {
+                    replica: {
+                        "age_s": round(wall - ts, 3),
+                        "reason": reason,
+                    }
+                    for replica, (ts, reason) in self._last_errors.items()
                 },
             }
 
@@ -146,6 +158,10 @@ class ClusterMembership:
             self._alive.discard(replica_id)
             self._ring = self._ring.without(replica_id)
             self._failover_count += 1
+            self._last_errors[replica_id] = (
+                time.time(),
+                reason or "marked dead",
+            )
             ring = self._ring
             version = ring.version
             alive = len(self._alive)

@@ -26,6 +26,16 @@ Routing discipline:
   replication follower has been keeping that slice warm
   (``replication.py``).  Application errors propagate; only transport
   failures fail over.
+* **Observability** (docs/observability.md "Fleet tracing"): when the
+  calling context carries a sampled trace, every owner RPC records a
+  ``cluster.rpc`` span (replica + method attrs) and forwards the
+  trace context on the wire; span summaries piggybacked on the reply
+  are stitched back in as children — ONE trace covers the whole
+  fan-out, including a failed RPC and its re-routed retry.  Always-on
+  fan-out attribution (``rpc_stats()``, the ``/debug/cluster`` rpc
+  panel) tallies per-replica latency/error/retry counters plus the
+  sequential critical-path breakdown (owner RPCs per lookup) that
+  baselines the read-path pipelining work (ROADMAP item 3).
 
 Not provided: ``version_vector`` / ``touch_chain`` — the indexer's
 exact-prompt score memo detects their absence and disables itself (a
@@ -37,6 +47,7 @@ alive replica's dump; standby slices may duplicate keys, which
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -45,13 +56,25 @@ from llm_d_kv_cache_manager_tpu.cluster.replica import (
     ReplicaUnavailable,
     decode_entries,
     encode_entries,
+    resolve_trace_piggyback_env,
 )
 from llm_d_kv_cache_manager_tpu.cluster.ring import HashRing
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, PodEntry
-from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS, safe_label
+from llm_d_kv_cache_manager_tpu.obs.trace import (
+    Span,
+    current_trace,
+    shield_trace,
+)
+from llm_d_kv_cache_manager_tpu.utils import lockorder
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("cluster.remote_index")
+
+# Leaf lock: per-replica RPC tallies only — never a transport call or
+# a membership flip under it.
+# kvlint: lock-order: RemoteIndex._stats_lock ascending
+lockorder.declare_ascending("RemoteIndex._stats_lock")
 
 
 class RemoteIndex(Index):
@@ -59,8 +82,45 @@ class RemoteIndex(Index):
 
     _OWNER_CACHE_MAX = 65536
 
-    def __init__(self, membership: ClusterMembership) -> None:
+    # Stitched cluster.rpc spans nest under the stage whose time they
+    # attribute: read fan-out inside the fast lane's "index_lookup",
+    # everything else inside the event plane's "kvevents.apply".
+    _RPC_TRACE_PARENT = {
+        "lookup": "index_lookup",
+        "lookup_chain": "index_lookup",
+    }
+
+    def __init__(
+        self,
+        membership: ClusterMembership,
+        trace_rpcs: Optional[bool] = None,
+        rpc_accounting: bool = True,
+    ) -> None:
         self.membership = membership
+        # Trace-context forwarding + span stitching on traced calls
+        # (None -> CLUSTER_TRACE_PIGGYBACK, default on; untraced calls
+        # never pay for it either way).
+        self.trace_rpcs = (
+            resolve_trace_piggyback_env()
+            if trace_rpcs is None
+            else trace_rpcs
+        )
+        # Per-replica tallies + the kvtpu_cluster_rpc_* families; the
+        # bench's trace A/B cell flips this off to price the whole
+        # observability plane on the untraced path.
+        self.rpc_accounting = rpc_accounting
+        self._stats_lock = lockorder.tracked(
+            threading.Lock(), "RemoteIndex._stats_lock"
+        )
+        self._rpc_tallies: Dict[str, dict] = {}  # guarded-by: _stats_lock
+        self._reroutes = 0  # guarded-by: _stats_lock
+        self._lookup_calls = 0  # guarded-by: _stats_lock
+        self._lookup_owner_rpcs = 0  # guarded-by: _stats_lock
+        self._lookup_owner_max = 0  # guarded-by: _stats_lock
+        self._lookup_rpc_s = 0.0  # guarded-by: _stats_lock
+        # method -> labeled histogram child (labels() does a lock +
+        # dict lookup per call; the method set is tiny and fixed).
+        self._latency_children: Dict[str, object] = {}
         # key -> (ring, owner), validated by ring IDENTITY on read: a
         # membership change produces a new immutable ring object, so a
         # stale entry can never validate (same single-key-dict-op
@@ -83,23 +143,146 @@ class RemoteIndex(Index):
     def _max_attempts(self) -> int:
         return len(self.membership.members()) + 1
 
+    def _rpc_latency(self, method: str):
+        child = self._latency_children.get(method)
+        if child is None:
+            child = METRICS.cluster_rpc_latency.labels(method=method)
+            self._latency_children[method] = child
+        return child
+
+    def _tally(
+        self,
+        replica_id: str,
+        method: str,
+        elapsed: float,
+        error: Optional[Tuple[str, str]] = None,
+    ) -> None:
+        """Per-replica fan-out attribution (the /debug/cluster rpc
+        panel): call/error counts, latency totals, per-method split,
+        and the last transport error's context."""
+        with self._stats_lock:
+            entry = self._rpc_tallies.get(replica_id)
+            if entry is None:
+                entry = self._rpc_tallies[replica_id] = {
+                    "calls": 0,
+                    "errors": 0,
+                    "total_s": 0.0,
+                    "max_s": 0.0,
+                    "methods": {},
+                    "last_error": None,
+                }
+            entry["calls"] += 1
+            entry["total_s"] += elapsed
+            if elapsed > entry["max_s"]:
+                entry["max_s"] = elapsed
+            methods = entry["methods"]
+            methods[method] = methods.get(method, 0) + 1
+            if method in self._RPC_TRACE_PARENT:
+                self._lookup_rpc_s += elapsed
+            if error is not None:
+                entry["errors"] += 1
+                entry["last_error"] = {
+                    "kind": error[0],
+                    "method": method,
+                    "detail": error[1][:200],
+                    "unix": time.time(),
+                }
+
+    def _stitch(
+        self, trace, wire_spans: list, anchor: float, replica_id: str
+    ) -> None:
+        """Re-anchor piggybacked server-side span records inside the
+        RPC window (their clocks are replica-relative).  Malformed
+        records never fail the call — the piggyback is advisory."""
+        try:
+            for record in wire_spans:
+                name, parent, start_us, dur_us, status, attrs = record
+                span = Span(
+                    str(name),
+                    str(parent) or "cluster.rpc",
+                    anchor + float(start_us) / 1e6,
+                )
+                span.end = span.start + max(0.0, float(dur_us)) / 1e6
+                span.status = str(status)
+                for pair in attrs:
+                    span.attrs[str(pair[0])] = pair[1]
+                span.attrs.setdefault("replica", replica_id)
+                trace.append_span(span)
+        except Exception:  # noqa: BLE001 — advisory, never fails the RPC
+            logger.debug(
+                "garbled span piggyback from replica %s",
+                replica_id,
+                exc_info=True,
+            )
+
+    def _call_traced(
+        self, trace, transport, replica_id: str, method: str,
+        args: list, start: float,
+    ):
+        """Traced transport call: a cluster.rpc span per owner RPC,
+        trace context on the wire, reply spans stitched back in."""
+        with trace.span(
+            "cluster.rpc",
+            parent=self._RPC_TRACE_PARENT.get(method, "kvevents.apply"),
+        ) as rpc:
+            rpc.set_attr("replica", replica_id)
+            rpc.set_attr("method", method)
+            call_ex = getattr(transport, "call_ex", None)
+            if call_ex is None:
+                # Foreign transport without the traced surface: the
+                # RPC span still attributes the hop.
+                return transport.call(method, args)
+            result, spans = call_ex(
+                method, args, traceparent=trace.traceparent()
+            )
+            if spans:
+                rpc.set_attr("server_spans", len(spans))
+                self._stitch(trace, spans, start, replica_id)
+            return result
+
     def _call(self, replica_id: str, method: str, args: list):
         """One transport call with latency/error accounting; transport
         failures mark the replica dead (the failover trigger) before
         re-raising for the caller's re-route loop."""
         transport = self.membership.transport(replica_id)
+        ambient = current_trace()
+        trace = ambient if self.trace_rpcs else None
         start = time.perf_counter()
         try:
-            result = transport.call(method, args)
+            if trace is None:
+                if ambient is not None:
+                    # trace_rpcs off with a live trace: shield the
+                    # in-process transport so the replica's direct
+                    # context-var record cannot leak orphan replica.*
+                    # spans under a cluster.rpc parent that was never
+                    # opened — the knob disables the WHOLE plane.
+                    with shield_trace():
+                        result = transport.call(method, args)
+                else:
+                    result = transport.call(method, args)
+            else:
+                result = self._call_traced(
+                    trace, transport, replica_id, method, args, start
+                )
         except (ReplicaUnavailable, ConnectionError, OSError) as exc:
-            METRICS.cluster_remote_errors.labels(op=method).inc()
+            elapsed = time.perf_counter() - start
+            kind = getattr(exc, "kind", None) or "io"
+            METRICS.cluster_rpc_errors.labels(
+                replica=safe_label(replica_id),
+                kind=safe_label(kind),
+            ).inc()
+            if self.rpc_accounting:
+                self._tally(
+                    replica_id, method, elapsed, error=(kind, str(exc))
+                )
             self.membership.mark_dead(
                 replica_id, f"{method} failed: {exc}"
             )
-            raise ReplicaUnavailable(str(exc)) from exc
-        METRICS.cluster_remote_latency.labels(op=method).observe(
-            time.perf_counter() - start
-        )
+            raise ReplicaUnavailable(str(exc), kind=kind) from exc
+        elapsed = time.perf_counter() - start
+        self._rpc_latency(method).observe(elapsed)
+        if self.rpc_accounting:
+            self._tally(replica_id, method, elapsed)
         return result
 
     def _call_routed(self, key: int, method: str, args: list):
@@ -116,6 +299,8 @@ class RemoteIndex(Index):
                     # mark_dead refused (last replica alive): re-routing
                     # would loop on the same owner forever.
                     break
+                with self._stats_lock:
+                    self._reroutes += 1
         assert last_exc is not None
         raise last_exc
 
@@ -162,6 +347,8 @@ class RemoteIndex(Index):
                 return
             if self.membership.ring() is ring:
                 break
+            with self._stats_lock:
+                self._reroutes += len(failed)
             seen = set()
             pending = []
             for item in failed:
@@ -186,21 +373,73 @@ class RemoteIndex(Index):
             raise ValueError("no request keys provided for lookup")
         pods_arg = sorted(pod_identifier_set) if pod_identifier_set else None
         result: Dict[int, List[PodEntry]] = {}
+        rounds: List[int] = []
 
         def plan(ring, pending):
-            return [
+            plans = [
                 (owner, "lookup", [keys, pods_arg], keys)
                 for owner, keys in self._group_by_owner(
                     ring, pending
                 ).items()
             ]
+            rounds.append(len(plans))
+            return plans
 
         def on_result(pairs):
             for key, raw_entries in pairs:
                 result[key] = list(decode_entries(raw_entries))
 
         self._fanout(list(request_keys), plan, on_result)
+        if self.rpc_accounting:
+            # Sequential critical path: the fan-out loop issues one RPC
+            # per owner per round, back to back — first-round width is
+            # the per-chunk serial depth item 3's pipelining attacks.
+            with self._stats_lock:
+                self._lookup_calls += 1
+                self._lookup_owner_rpcs += sum(rounds)
+                if rounds and rounds[0] > self._lookup_owner_max:
+                    self._lookup_owner_max = rounds[0]
         return result
+
+    def rpc_stats(self) -> dict:
+        """The /debug/cluster per-replica rpc panel: fan-out
+        attribution tallies plus the sequential-owner critical-path
+        breakdown (the read-path pipelining baseline)."""
+        with self._stats_lock:
+            replicas: Dict[str, dict] = {}
+            for replica_id, entry in sorted(self._rpc_tallies.items()):
+                calls = entry["calls"]
+                view = {
+                    "calls": calls,
+                    "errors": entry["errors"],
+                    "total_ms": round(entry["total_s"] * 1e3, 3),
+                    "avg_ms": (
+                        round(entry["total_s"] / calls * 1e3, 3)
+                        if calls
+                        else 0.0
+                    ),
+                    "max_ms": round(entry["max_s"] * 1e3, 3),
+                    "methods": dict(entry["methods"]),
+                }
+                if entry["last_error"] is not None:
+                    view["last_error"] = dict(entry["last_error"])
+                replicas[replica_id] = view
+            lookups = self._lookup_calls
+            return {
+                "replicas": replicas,
+                "reroutes": self._reroutes,
+                "critical_path": {
+                    "lookup_calls": lookups,
+                    "owner_rpcs": self._lookup_owner_rpcs,
+                    "avg_owners_per_lookup": (
+                        round(self._lookup_owner_rpcs / lookups, 3)
+                        if lookups
+                        else 0.0
+                    ),
+                    "max_owners_per_lookup": self._lookup_owner_max,
+                    "sequential_rpc_s": round(self._lookup_rpc_s, 6),
+                },
+            }
 
     def lookup_chain(
         self, request_keys: Sequence[int]

@@ -7,11 +7,24 @@ cluster smoke) and the HTTP endpoint (``POST /replica`` in
 ``api/http_service.py``) both land in :meth:`ClusterReplica.handle`.
 
 Wire format (canonical CBOR, the house serialization — lists only, no
-maps): request ``[method, args]``, response ``[status, payload]`` with
+maps): request ``[method, args]`` or ``[method, args, traceparent]``,
+response ``[status, payload]`` or ``[status, payload, spans]`` with
 status 0=ok / 1=application error (payload is the message).  Transport
 failures raise :class:`ReplicaUnavailable`; application errors raise
 :class:`ReplicaError` — the router treats only the former as a
 failover trigger.
+
+Trace piggyback (docs/observability.md "Fleet tracing"): a request
+whose third element is a sampled W3C ``traceparent`` makes the replica
+record server-side spans (wire decode + the method's lookup/apply
+split) and return their summaries as the response's third element —
+``[name, parent, start_us, dur_us, status, [[attr, value], ...]]``
+records relative to the replica's receive time.  The router
+(``remote_index.py``) stitches them under its own ``cluster.rpc`` span
+so ONE trace covers the whole fan-out with no collector process.
+Two-element frames remain valid in both directions (mixed-version
+fleets, untraced requests pay zero bytes); ``CLUSTER_TRACE_PIGGYBACK=0``
+disables the server-side harvest outright.
 
 Journal tap (replication feed): every mutating call is appended to the
 replica's own journal AFTER the local apply succeeds — the same
@@ -26,7 +39,10 @@ router worker (RPCs from one worker are synchronous).
 
 from __future__ import annotations
 
+import os
+import socket
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cbor_canonical import (
@@ -38,9 +54,28 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
     InMemoryIndex,
 )
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, PodEntry
+from llm_d_kv_cache_manager_tpu.obs.trace import (
+    TRACER,
+    Trace,
+    _new_span_id,
+    parse_traceparent,
+    shield_trace,
+    span as obs_span,
+    use_trace,
+)
 from llm_d_kv_cache_manager_tpu.utils.logging import get_logger
 
 logger = get_logger("cluster.replica")
+
+
+def resolve_trace_piggyback_env() -> bool:
+    """CLUSTER_TRACE_PIGGYBACK: "0"/"false"/"off" disables carrying
+    span summaries on replica replies; unset/anything else keeps the
+    piggyback on (docs/observability.md)."""
+    raw = os.environ.get("CLUSTER_TRACE_PIGGYBACK")
+    if raw is None:
+        return True
+    return raw.strip().lower() not in ("0", "false", "off", "no")
 
 
 class ReplicaError(RuntimeError):
@@ -48,7 +83,17 @@ class ReplicaError(RuntimeError):
 
 
 class ReplicaUnavailable(ConnectionError):
-    """The replica could not be reached (transport-level failure)."""
+    """The replica could not be reached (transport-level failure).
+
+    ``kind`` classifies the failure for the
+    ``kvtpu_cluster_rpc_errors_total{replica,kind}`` attribution:
+    ``timeout`` / ``refused`` / ``wire_decode`` / ``http_status`` /
+    ``killed`` / ``io``.
+    """
+
+    def __init__(self, message: str, kind: str = "io") -> None:
+        super().__init__(message)
+        self.kind = kind
 
 
 # -- wire helpers -------------------------------------------------------
@@ -62,32 +107,89 @@ def decode_entries(raw) -> Tuple[PodEntry, ...]:
     return tuple(PodEntry(str(p), str(t)) for p, t in raw)
 
 
-def encode_request(method: str, args: list) -> bytes:
-    return encode_canonical([method, args])
+def encode_request(
+    method: str, args: list, traceparent: Optional[str] = None
+) -> bytes:
+    """Two elements untraced, three with a trace context — untraced
+    requests pay zero extra wire bytes."""
+    if traceparent is None:
+        return encode_canonical([method, args])
+    return encode_canonical([method, args, traceparent])
 
 
-def decode_request(data: bytes) -> Tuple[str, list]:
+def decode_request(data: bytes) -> Tuple[str, list, Optional[str]]:
     doc = decode_canonical(data)
-    if not isinstance(doc, list) or len(doc) != 2:
+    if not isinstance(doc, list) or len(doc) not in (2, 3):
         raise CborDecodeError("unexpected replica request shape")
-    method, args = doc
+    method, args = doc[0], doc[1]
+    traceparent = doc[2] if len(doc) == 3 else None
     if not isinstance(method, str) or not isinstance(args, list):
         raise CborDecodeError("unexpected replica request shape")
-    return method, args
+    if traceparent is not None and not isinstance(traceparent, str):
+        raise CborDecodeError("unexpected replica request shape")
+    return method, args, traceparent
 
 
-def encode_response(status: int, payload) -> bytes:
-    return encode_canonical([status, payload])
+def encode_response(status: int, payload, spans: Optional[list] = None) -> bytes:
+    if spans is None:
+        return encode_canonical([status, payload])
+    return encode_canonical([status, payload, spans])
+
+
+def decode_response_ex(data: bytes) -> Tuple[object, Optional[list]]:
+    """(payload, piggybacked span records or None); raises
+    :class:`ReplicaError` on a status-1 frame."""
+    doc = decode_canonical(data)
+    if not isinstance(doc, list) or len(doc) not in (2, 3):
+        raise CborDecodeError("unexpected replica response shape")
+    status, payload = doc[0], doc[1]
+    spans = doc[2] if len(doc) == 3 else None
+    if spans is not None and not isinstance(spans, list):
+        raise CborDecodeError("unexpected replica response shape")
+    if status:
+        raise ReplicaError(str(payload))
+    return payload, spans
 
 
 def decode_response(data: bytes):
-    doc = decode_canonical(data)
-    if not isinstance(doc, list) or len(doc) != 2:
-        raise CborDecodeError("unexpected replica response shape")
-    status, payload = doc
-    if status:
-        raise ReplicaError(str(payload))
+    """Payload-only view (the pre-piggyback contract, kept for every
+    caller that does not stitch spans)."""
+    payload, _ = decode_response_ex(data)
     return payload
+
+
+def _wire_attr(value):
+    """Span attribute values on the canonical-CBOR wire: ints and
+    strings pass, everything else is stringified (lists-only codec —
+    no floats, no maps)."""
+    if isinstance(value, bool):
+        return int(value)
+    if isinstance(value, (int, str)):
+        return value
+    return str(value)
+
+
+def encode_harvest_spans(harvest: Trace) -> list:
+    """Serialize a server-side span harvest for the reply piggyback:
+    ``[name, parent, start_us, dur_us, status, [[attr, value], ...]]``
+    with times relative to the harvest's start (the replica's receive
+    point) — the router re-anchors them inside its RPC span."""
+    out: list = []
+    for view in harvest.to_dict(include_spans=True)["spans"]:
+        out.append(
+            [
+                view["name"],
+                view["parent"] or "",
+                int(view["start_ms"] * 1000),
+                int(view["duration_ms"] * 1000),
+                view["status"],
+                [
+                    [str(key), _wire_attr(value)]
+                    for key, value in view["attributes"].items()
+                ],
+            ]
+        )
+    return out
 
 
 class ClusterReplica:
@@ -100,18 +202,34 @@ class ClusterReplica:
     file layer.
     """
 
+    # Server-side span vocabulary (docs/observability.md): the method
+    # table split into the lookup/apply/admin stages a stitched trace
+    # shows, all children of the router's "cluster.rpc" span.
+    _READ_METHODS = frozenset({"lookup", "lookup_chain"})
+    _ADMIN_METHODS = frozenset(
+        {"ping", "get_request_key", "dump_entries", "sync_snapshot"}
+    )
+
     def __init__(
         self,
         replica_id: str,
         index: Optional[Index] = None,
         journal=None,
         journal_retain_segments: int = 64,
+        trace_piggyback: Optional[bool] = None,
     ) -> None:
         if not replica_id:
             raise ValueError("replica_id required")
         self.replica_id = replica_id
         self.index = index if index is not None else InMemoryIndex()
         self.journal = journal
+        # Piggyback server-side spans on traced requests' replies
+        # (None -> CLUSTER_TRACE_PIGGYBACK, default on).
+        self.trace_piggyback = (
+            resolve_trace_piggyback_env()
+            if trace_piggyback is None
+            else trace_piggyback
+        )
         # Replication journals have no snapshot boundary to compact
         # against, so they get size-based retention: the newest N
         # segments survive (~N x segment_max_bytes on disk), checked
@@ -148,29 +266,87 @@ class ClusterReplica:
 
     # -- dispatch -------------------------------------------------------
 
+    def _stage_for(self, method: str) -> str:
+        if method in self._READ_METHODS:
+            return "replica.lookup"
+        if method in self._ADMIN_METHODS:
+            return "replica.admin"
+        return "replica.apply"
+
     def handle(self, method: str, args: list):
         """Execute one RPC; raises ``ReplicaError`` for unknown methods
-        (application-level: the replica IS reachable)."""
+        (application-level: the replica IS reachable).
+
+        The dispatch records a server-side span on whatever trace is
+        active in the context — the wire path's harvest trace, or (for
+        the in-process transport) the router's own trace directly; a
+        free no-op when nothing is traced, and ``trace_piggyback``
+        disables server-side spans on EVERY path (the in-process
+        direct record included, so the knob means the same thing over
+        both transports)."""
         handler = self._methods.get(method)
         if handler is None:
             raise ReplicaError(f"unknown replica method: {method!r}")
-        return handler(args)
+        if not self.trace_piggyback:
+            return handler(args)
+        with obs_span(self._stage_for(method), parent="cluster.rpc") as s:
+            s.set_attr("replica", self.replica_id)
+            s.set_attr("method", method)
+            return handler(args)
 
     def handle_wire(self, data: bytes) -> bytes:
         """Decode request bytes, execute, encode response bytes — the
         HTTP endpoint's whole body.  Application errors (including
         malformed requests) become status-1 responses, never transport
-        failures."""
+        failures.  A sampled traceparent in the request frame turns on
+        the span harvest: server-side spans ride back on the reply."""
+        received = time.perf_counter()
         try:
-            method, args = decode_request(data)
-            payload = self.handle(method, args)
+            method, args, traceparent = decode_request(data)
+        except Exception as exc:  # noqa: BLE001 — becomes a wire error
+            return encode_response(1, repr(exc))
+        harvest: Optional[Trace] = None
+        if traceparent is not None and self.trace_piggyback:
+            parent = parse_traceparent(traceparent)
+            if parent is not None and parent.sampled:
+                # Never finished/recorded locally: the spans exist only
+                # to ride the reply; the ROUTER's stitched trace is the
+                # single record (no collector, no double counting).
+                harvest = Trace(
+                    f"replica.{self.replica_id}",
+                    parent.trace_id,
+                    _new_span_id(),
+                    TRACER.recorder,
+                    parent_span_id=parent.span_id,
+                )
+                harvest.add_completed(
+                    "replica.decode", received, parent="cluster.rpc"
+                )
+        try:
+            # shield_trace makes the in-process strict-wire transport
+            # behave exactly like the HTTP one: server spans travel
+            # only via the piggyback, never by context-var leakage.
+            with shield_trace():
+                if harvest is not None:
+                    with use_trace(harvest):
+                        payload = self.handle(method, args)
+                else:
+                    payload = self.handle(method, args)
         except Exception as exc:  # noqa: BLE001 — becomes a wire error
             if not isinstance(exc, ReplicaError):
                 logger.exception(
                     "replica %s RPC failed", self.replica_id
                 )
             return encode_response(1, repr(exc))
-        return encode_response(0, payload)
+        spans = None
+        if harvest is not None:
+            try:
+                spans = encode_harvest_spans(harvest)
+            except Exception:  # noqa: BLE001 — piggyback is advisory
+                logger.exception(
+                    "replica %s span piggyback failed", self.replica_id
+                )
+        return encode_response(0, payload, spans)
 
     # -- methods --------------------------------------------------------
 
@@ -358,16 +534,31 @@ class LocalReplicaTransport:
         self._killed.clear()
 
     def call(self, method: str, args: list):
+        payload, _ = self.call_ex(method, args)
+        return payload
+
+    def call_ex(
+        self,
+        method: str,
+        args: list,
+        traceparent: Optional[str] = None,
+    ) -> Tuple[object, Optional[list]]:
+        """(payload, piggybacked spans).  The non-strict path runs the
+        handler on the CALLER's thread, so an active trace receives
+        the replica-side spans directly through the context var — no
+        piggyback needed (None); the strict path round-trips the full
+        wire contract including the trace context."""
         if self._killed.is_set():
             raise ReplicaUnavailable(
-                f"replica {self.replica.replica_id} is down"
+                f"replica {self.replica.replica_id} is down",
+                kind="killed",
             )
         if not self.strict_wire:
-            return self.replica.handle(method, args)
+            return self.replica.handle(method, args), None
         response = self.replica.handle_wire(
-            encode_request(method, args)
+            encode_request(method, args, traceparent)
         )
-        return decode_response(response)
+        return decode_response_ex(response)
 
     def close(self) -> None:
         return None
@@ -428,8 +619,29 @@ class HttpReplicaTransport:
                 pass
             self._local.conn = None
 
+    @staticmethod
+    def _failure_kind(exc: BaseException) -> str:
+        """Classify a transport failure for the per-replica error
+        attribution (``kvtpu_cluster_rpc_errors_total{replica,kind}``):
+        a timeout, a refused connect, and garbled bytes are three
+        different operational stories."""
+        if isinstance(exc, (TimeoutError, socket.timeout)):
+            return "timeout"
+        if isinstance(exc, ConnectionRefusedError):
+            return "refused"
+        return "io"
+
     def call(self, method: str, args: list):
-        body = encode_request(method, args)
+        payload, _ = self.call_ex(method, args)
+        return payload
+
+    def call_ex(
+        self,
+        method: str,
+        args: list,
+        traceparent: Optional[str] = None,
+    ) -> Tuple[object, Optional[list]]:
+        body = encode_request(method, args, traceparent)
         try:
             conn = self._connection()
             conn.request(
@@ -441,20 +653,23 @@ class HttpReplicaTransport:
             self._drop_connection()
             raise ReplicaUnavailable(
                 f"replica at {self._host}:{self._port} unreachable: "
-                f"{exc}"
+                f"{exc}",
+                kind=self._failure_kind(exc),
             ) from exc
         if response.status != 200:
             self._drop_connection()
             raise ReplicaUnavailable(
                 f"replica at {self._host}:{self._port} returned HTTP "
-                f"{response.status}"
+                f"{response.status}",
+                kind="http_status",
             )
         try:
-            return decode_response(data)
+            return decode_response_ex(data)
         except CborDecodeError as exc:
             self._drop_connection()
             raise ReplicaUnavailable(
-                f"garbled replica response: {exc}"
+                f"garbled replica response: {exc}",
+                kind="wire_decode",
             ) from exc
 
     def close(self) -> None:

@@ -81,6 +81,11 @@ DEFAULT_LOOKUP_CHUNK = 32
 # the index's per-shard version vector); 0 disables.
 DEFAULT_SCORE_MEMO = 256
 
+# One-shot guard for the memo-self-disable warning (every Indexer over
+# a RemoteIndex hits the same condition; one line per process is the
+# signal, N lines is noise).
+_MEMO_DISABLED_WARNED = False
+
 
 def _env_fast_lane_default() -> Optional[bool]:
     raw = os.environ.get("READ_PATH_FAST_LANE")
@@ -319,15 +324,36 @@ class Indexer:
         from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
 
         self._score_memo: Optional[LRUCache] = None
-        if (
-            self._fast_lane
-            and memo_size > 0
-            and callable(
-                getattr(self.kv_block_index, "version_vector", None)
-            )
-            and callable(getattr(self.kv_block_index, "touch_chain", None))
-        ):
+        memo_wanted = self._fast_lane and memo_size > 0
+        memo_supported = callable(
+            getattr(self.kv_block_index, "version_vector", None)
+        ) and callable(getattr(self.kv_block_index, "touch_chain", None))
+        if memo_wanted and memo_supported:
             self._score_memo = LRUCache(memo_size)
+        # The silent self-disable was invisible to operators: a fleet
+        # deployment (RemoteIndex has no version_vector) pays the full
+        # walk on warm repeats while a single-process one memoizes —
+        # the gauge + one-shot warning make that difference
+        # diagnosable (docs/observability.md).  The gauge LATCHES to 1
+        # (never written back to 0): it is process-wide, and a later
+        # memo-capable Indexer construction — embedders and tests
+        # build several — must not wipe the serving indexer's signal.
+        if memo_wanted and not memo_supported:
+            from llm_d_kv_cache_manager_tpu.metrics.collector import (
+                METRICS,
+            )
+
+            METRICS.score_memo_disabled.set(1)
+            global _MEMO_DISABLED_WARNED
+            if not _MEMO_DISABLED_WARNED:
+                _MEMO_DISABLED_WARNED = True
+                logger.warning(
+                    "request score memo disabled: index backend %s "
+                    "lacks version_vector/touch_chain (expected for "
+                    "the cluster RemoteIndex) — warm repeat prompts "
+                    "pay the full fan-out; kvtpu_score_memo_disabled=1",
+                    type(self.kv_block_index).__name__,
+                )
 
         # Hit-attribution ledger (analytics/ledger.py): an explicit
         # ledger always wins (tests, bench A/B share one ledger across

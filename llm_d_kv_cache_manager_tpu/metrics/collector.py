@@ -338,22 +338,25 @@ class KVCacheMetrics:
             "replica's slice to its rendezvous runner-up.",
             registry=self.registry,
         )
-        self.cluster_remote_latency = Histogram(
-            f"{_NAMESPACE}_cluster_remote_latency_seconds",
-            "Latency of router->replica RPCs by operation.",
-            ("op",),
+        self.cluster_rpc_latency = Histogram(
+            f"{_NAMESPACE}_cluster_rpc_latency_seconds",
+            "Latency of router->replica RPCs by replica method (the "
+            "fan-out attribution view; per-replica panels live in "
+            "/debug/cluster).",
+            ("method",),
             registry=self.registry,
             buckets=(
                 0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
             ),
         )
-        self.cluster_remote_errors = Counter(
-            f"{_NAMESPACE}_cluster_remote_errors_total",
-            "Router->replica RPCs that failed at the transport layer, "
-            "by operation (each marks the replica dead and retries on "
-            "the failover owner).",
-            ("op",),
+        self.cluster_rpc_errors = Counter(
+            f"{_NAMESPACE}_cluster_rpc_errors_total",
+            "Router->replica RPC transport failures by replica and "
+            "failure kind (timeout / refused / wire_decode / "
+            "http_status / killed / io); each marks the replica dead "
+            "and retries on the failover owner.",
+            ("replica", "kind"),
             registry=self.registry,
         )
         self.cluster_replica_lag = Gauge(
@@ -368,6 +371,57 @@ class KVCacheMetrics:
             "Journal records applied by replication followers, by "
             "followed peer.",
             ("peer",),
+            registry=self.registry,
+        )
+        # Read-path SLO feed: end-to-end scored-request latency at the
+        # service boundary (api/http_service.py), unsampled — unlike
+        # stage_latency below this sees EVERY request, so the SLO
+        # engine's latency SLI (obs/slo.py) windows an unbiased stream.
+        self.score_latency = Histogram(
+            f"{_NAMESPACE}_score_latency_seconds",
+            "End-to-end latency of scored requests at the HTTP service "
+            "boundary (every request — errors included, not just "
+            "sampled traces).",
+            registry=self.registry,
+            buckets=(
+                0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5,
+            ),
+        )
+        self.score_requests = Counter(
+            f"{_NAMESPACE}_score_requests_total",
+            "Scored requests at the HTTP service boundary by outcome "
+            "(ok / error) — the availability SLI's feed: a fully "
+            "failing service must read as violated, not as a no-data "
+            "latency SLI.",
+            ("outcome",),
+            registry=self.registry,
+        )
+        # Score memo visibility (kvcache/indexer.py): 1 when the
+        # exact-prompt memo was requested but self-disabled because the
+        # backend lacks version_vector/touch_chain (the RemoteIndex
+        # case) — the reason warm-traffic latency differs between
+        # single-process and fleet deployments.
+        self.score_memo_disabled = Gauge(
+            f"{_NAMESPACE}_score_memo_disabled",
+            "1 when the request score memo is configured but disabled "
+            "by the index backend (no version_vector/touch_chain — "
+            "e.g. the cluster RemoteIndex), else 0.",
+            registry=self.registry,
+        )
+        # SLO engine (obs/slo.py; docs/observability.md).
+        self.slo_state = Gauge(
+            f"{_NAMESPACE}_slo_state",
+            "Degradation-envelope state per SLI (0 healthy / 1 "
+            "degraded / 2 violated); sli=\"overall\" is the worst.",
+            ("sli",),
+            registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            f"{_NAMESPACE}_slo_burn_rate",
+            "Error-budget burn rate per SLI and evaluation window "
+            "(1.0 = burning exactly the objective's budget).",
+            ("sli", "window"),
             registry=self.registry,
         )
         # Per-stage latencies fed by the tracing subsystem (obs/trace.py):

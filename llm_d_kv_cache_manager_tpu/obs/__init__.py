@@ -8,6 +8,12 @@ See docs/observability.md.  Import surface:
 """
 
 from llm_d_kv_cache_manager_tpu.obs.recorder import FlightRecorder
+from llm_d_kv_cache_manager_tpu.obs.slo import (
+    SloEngine,
+    SloSpec,
+    default_fleet_slos,
+    envelope_violations,
+)
 from llm_d_kv_cache_manager_tpu.obs.trace import (
     TRACER,
     ParentContext,
@@ -24,6 +30,10 @@ from llm_d_kv_cache_manager_tpu.obs.trace import (
 
 __all__ = [
     "FlightRecorder",
+    "SloEngine",
+    "SloSpec",
+    "default_fleet_slos",
+    "envelope_violations",
     "TRACER",
     "ParentContext",
     "Span",

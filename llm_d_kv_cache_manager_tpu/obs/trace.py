@@ -499,6 +499,32 @@ def span(name: str, parent: Optional[str] = None):
     return trace.span(name, parent)
 
 
+class shield_trace:
+    """Clear the active trace for a scope.
+
+    The process-boundary guard: an in-process wire server (the
+    cluster replica's ``handle_wire`` under ``LocalReplicaTransport``
+    strict mode) must behave exactly like its cross-process twin —
+    server-side spans travel only via the explicit piggyback, never by
+    leaking through the caller's context var.
+    """
+
+    __slots__ = ("_token",)
+
+    def __init__(self) -> None:
+        self._token = None
+
+    def __enter__(self) -> None:
+        self._token = _CURRENT.set(None)
+        return None
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+        return False
+
+
 # Process-wide tracer, mirroring metrics.collector.METRICS: modules
 # import this instead of plumbing a tracer through every constructor.
 TRACER = Tracer()

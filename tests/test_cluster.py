@@ -748,5 +748,15 @@ class TestDebugClusterRouterPayload:
             status = cluster.status()
             assert status["membership"]["failovers"] == 1
             assert "replica-0" not in status["membership"]["alive"]
+            # Fan-out attribution panel (docs/observability.md): the
+            # add above produced per-replica tallies, and the kill's
+            # reason is retrievable as last-error context.
+            assert status["rpc"]["replicas"], status["rpc"]
+            for view in status["rpc"]["replicas"].values():
+                assert view["calls"] >= 1
+                assert "avg_ms" in view and "methods" in view
+            assert "critical_path" in status["rpc"]
+            last = status["membership"]["last_errors"]["replica-0"]
+            assert last["reason"] == "killed"
         finally:
             cluster.close()
