@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke offload-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke perf-trend profile-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke offload-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -77,6 +77,25 @@ slo-smoke:
 # asserting sane output + fast-lane score parity (docs/performance.md).
 perf-smoke:
 	$(CPU_ENV) $(PYTHON) hack/perf_smoke.py
+
+# Perf-trend gate (same invocation as CI's "Perf trend" step): parse
+# the BENCH_r*.json trajectory at the repo root, print the per-regime
+# headline trend table, and exit non-zero when the newest artifact
+# regresses a prior higher-is-better headline by >10%
+# (docs/benchmarks.md).
+perf-trend:
+	$(PYTHON) hack/perf_trend.py
+
+# Continuous-profiling smoke (same invocation as CI's "Profiling
+# smoke" step): booted service under named-thread traffic — collapsed
+# stacks attribute >=90% of samples to kvtpu-* roles, a planted
+# two-thread lock fight is visible per lock name in
+# /debug/profile?kind=locks AND kvtpu_lock_wait_seconds{lock}, the
+# timeline shows the traffic ramp, and the PROFILE_HZ=0 /
+# LOCK_CONTENTION_SAMPLE=0 off paths are verified zero-cost
+# (docs/observability.md).
+profile-smoke:
+	$(CPU_ENV) $(PYTHON) hack/profile_smoke.py
 
 # Cache-analytics smoke (same invocation as CI's "Cache analytics
 # smoke" step): booted service with the hit-attribution ledger + an

@@ -1,0 +1,283 @@
+"""Perf-trend gate over the BENCH_r*.json artifact trajectory.
+
+Every bench run the driver keeps lands as ``BENCH_r<NN>.json`` at the
+repo root — the project's only longitudinal perf record — but until
+this tool nothing READ the trajectory: a PR could halve a headline
+the previous bench pinned and no gate would notice.  This script:
+
+* parses every artifact, tolerating the three shapes the trajectory
+  actually contains (the early ``parsed`` metric/value/detail form,
+  the ``headline``+regime-block form, and the compact-headline form
+  ``emit_result`` prints today), plus errored runs (``rc != 0`` or a
+  device-unavailable ``error`` field) which are shown but never used
+  as a baseline;
+* prints a per-regime headline trend table (value per run, newest
+  delta vs the most recent prior run that measured the same
+  headline);
+* exits non-zero when the NEWEST artifact regresses any
+  higher-is-better headline by more than ``--threshold`` (default
+  10%) against that prior value.
+
+Regimes rotate between runs, so a headline absent from the newest
+artifact is simply not compared — only measured regressions fail.
+
+Run: ``python hack/perf_trend.py`` (CI step "Perf trend", also
+``make perf-trend``); ``--dir`` points at a different artifact
+directory (tests use a tmpdir).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.10
+
+_ARTIFACT_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# Headline keys gated by the regression check.  All are
+# higher-is-better by construction (throughputs, speedups,
+# consistency ratios) — latency percentiles and workload-dependent
+# hit rates are shown in the table but never gated, because their
+# direction or baseline is not stable across regime rotations.
+GATED_HEADLINES = (
+    "ttft.speedup",
+    "read_path.warm_sps",
+    "read_path.cold_sps",
+    "read_path.mixed_sps",
+    "event_storm.apply_sps",
+    "event_storm.consistency",
+    "replica_scaleout.single_sps",
+    "replica_scaleout.cluster3_sps",
+)
+
+
+def _num(value) -> Optional[float]:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    return float(value)
+
+
+def _merged_containers(artifact: dict) -> dict:
+    """One flat view over the shapes the driver has stored: top-level
+    keys, plus whatever sits under ``parsed`` / ``headline`` /
+    ``compact`` when those are dicts (later containers never clobber
+    earlier keys)."""
+    merged: dict = {}
+    for container in (
+        artifact,
+        artifact.get("parsed"),
+        artifact.get("headline"),
+        artifact.get("compact"),
+    ):
+        if not isinstance(container, dict):
+            continue
+        for key, value in container.items():
+            merged.setdefault(key, value)
+    return merged
+
+
+def _block(merged: dict, name: str) -> dict:
+    """A regime sub-block by name; a ``headline`` whose ``regime``
+    field names the block (the BENCH_r06 shape) counts too."""
+    candidate = merged.get(name)
+    if isinstance(candidate, dict):
+        return candidate
+    if merged.get("regime") == name:
+        return merged
+    return {}
+
+
+def extract_headlines(artifact: dict) -> Dict[str, float]:
+    """headline key -> value for one artifact; empty when the run was
+    errored (rc != 0, or an ``error`` marker with a zeroed value)."""
+    rc = artifact.get("rc", 0)
+    if rc not in (0, None):
+        return {}
+    merged = _merged_containers(artifact)
+    out: Dict[str, float] = {}
+    errored = "error" in merged or "error" in artifact
+    metric = merged.get("metric")
+    value = _num(merged.get("value"))
+    if (
+        not errored
+        and isinstance(metric, str)
+        and metric.startswith("p50_ttft_speedup")
+        and value is not None
+        and value > 0
+    ):
+        out["ttft.speedup"] = value
+
+    read_path = _block(merged, "read_path")
+    for key, compact_name, full_path in (
+        ("read_path.warm_sps", "warm_sps", ("warm_multi_turn",)),
+        ("read_path.cold_sps", "cold_sps", ("cold",)),
+        ("read_path.mixed_sps", "mixed_sps", ("mixed",)),
+    ):
+        value = _num(read_path.get(compact_name))
+        if value is None:
+            cell = read_path.get(full_path[0])
+            if isinstance(cell, dict):
+                value = _num(cell.get("scores_per_sec"))
+        if value is not None and value > 0:
+            out[key] = value
+
+    storm = _block(merged, "event_storm")
+    apply_sps = _num(storm.get("apply_sps"))
+    if apply_sps is None:
+        apply_sps = _num(storm.get("apply_msgs_per_sec"))
+    if apply_sps is None:
+        cell = storm.get("consolidated_pollers_1")
+        if isinstance(cell, dict):
+            apply_sps = _num(cell.get("apply_msgs_per_sec"))
+    if apply_sps is not None and apply_sps > 0:
+        out["event_storm.apply_sps"] = apply_sps
+    consistency = _num(storm.get("consistency"))
+    if consistency is None:
+        gap = storm.get("gap_storm")
+        if isinstance(gap, dict):
+            consistency = _num(gap.get("post_resync_consistency"))
+    if consistency is not None and consistency > 0:
+        out["event_storm.consistency"] = consistency
+
+    scaleout = _block(merged, "replica_scaleout")
+    for key, compact_name, full_name in (
+        ("replica_scaleout.single_sps", "single_sps", "single"),
+        (
+            "replica_scaleout.cluster3_sps",
+            "cluster3_sps",
+            "cluster_3_replicas",
+        ),
+    ):
+        value = _num(scaleout.get(compact_name))
+        if value is None:
+            cell = scaleout.get(full_name)
+            if isinstance(cell, dict):
+                value = _num(cell.get("scores_per_sec"))
+        if value is not None and value > 0:
+            out[key] = value
+    return out
+
+
+def load_trajectory(
+    directory: str,
+) -> List[Tuple[int, str, Dict[str, float]]]:
+    """[(run number, filename, headlines)] sorted oldest first."""
+    runs: List[Tuple[int, str, Dict[str, float]]] = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        match = _ARTIFACT_RE.search(os.path.basename(path))
+        if not match:
+            continue
+        try:
+            with open(path) as handle:
+                artifact = json.load(handle)
+        except (OSError, ValueError) as exc:
+            print(f"perf-trend: skipping unreadable {path}: {exc}")
+            continue
+        if not isinstance(artifact, dict):
+            print(f"perf-trend: skipping non-object {path}")
+            continue
+        runs.append(
+            (
+                int(match.group(1)),
+                os.path.basename(path),
+                extract_headlines(artifact),
+            )
+        )
+    runs.sort(key=lambda item: item[0])
+    return runs
+
+
+def evaluate(
+    runs: List[Tuple[int, str, Dict[str, float]]],
+    threshold: float,
+) -> Tuple[List[str], List[str]]:
+    """(table lines, regression messages) for a loaded trajectory."""
+    lines: List[str] = []
+    regressions: List[str] = []
+    if not runs:
+        return ["perf-trend: no BENCH_r*.json artifacts found"], []
+    newest_n, newest_name, newest = runs[-1]
+    keys = sorted({key for _, _, headlines in runs for key in headlines})
+    lines.append(
+        f"perf-trend: {len(runs)} artifacts, newest {newest_name}, "
+        f"regression threshold {threshold:.0%}"
+    )
+    if not keys:
+        lines.append(
+            "perf-trend: no recognizable headlines in any artifact"
+        )
+        return lines, []
+    header = ["headline".ljust(30)] + [
+        f"r{n:02d}".rjust(10) for n, _, _ in runs
+    ]
+    lines.append("  ".join(header) + "   newest-vs-prior")
+    for key in keys:
+        row = [key.ljust(30)]
+        prior: Optional[float] = None
+        for n, _, headlines in runs:
+            value = headlines.get(key)
+            row.append(
+                f"{value:10.3f}" if value is not None else " " * 9 + "—"
+            )
+            if n != newest_n and value is not None:
+                prior = value  # most recent prior measurement wins
+        verdict = ""
+        current = newest.get(key)
+        if current is not None and prior is not None and prior > 0:
+            delta = (current - prior) / prior
+            verdict = f"{delta:+.1%}"
+            if key in GATED_HEADLINES and delta < -threshold:
+                verdict += "  REGRESSED"
+                regressions.append(
+                    f"{key}: {current:.3f} vs prior {prior:.3f} "
+                    f"({delta:+.1%} < -{threshold:.0%})"
+                )
+        elif current is not None:
+            verdict = "(no prior)"
+        elif prior is not None:
+            verdict = "(not in newest run)"
+        lines.append("  ".join(row) + f"   {verdict}")
+    return lines, regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="BENCH_r*.json headline trend table + >threshold "
+        "regression gate (docs/benchmarks.md)"
+    )
+    parser.add_argument(
+        "--dir",
+        default=os.path.join(os.path.dirname(__file__), ".."),
+        help="directory holding BENCH_r*.json (default: repo root)",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=DEFAULT_THRESHOLD,
+        help="fractional regression that fails the gate (default 0.10)",
+    )
+    args = parser.parse_args(argv)
+    runs = load_trajectory(args.dir)
+    lines, regressions = evaluate(runs, args.threshold)
+    for line in lines:
+        print(line)
+    if regressions:
+        print(
+            f"perf-trend: FAIL — {len(regressions)} headline(s) "
+            "regressed beyond threshold:"
+        )
+        for message in regressions:
+            print(f"  {message}")
+        return 1
+    print("perf-trend: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,8 @@ def _make_handler(
     replica=None,
     cluster_status=None,
     slo=None,
+    profiler=None,
+    timeline=None,
 ):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -259,6 +261,8 @@ def _make_handler(
                         logger.exception("slo status failed")
                         health["slo"] = {"error": "unavailable"}
                 self._reply_json(200, health)
+            elif path in ("/debug", "/debug/"):
+                self._debug_index()
             elif path == "/debug/traces":
                 self._debug_traces(query)
             elif path.startswith("/debug/traces/"):
@@ -271,8 +275,164 @@ def _make_handler(
                 self._debug_cluster()
             elif path == "/debug/slo":
                 self._debug_slo()
+            elif path == "/debug/profile":
+                self._debug_profile(query)
+            elif path == "/debug/timeline":
+                self._debug_timeline(query)
             else:
                 self._error(404, "not found")
+
+        def _debug_index(self):
+            """The debug-surface directory: every registered surface,
+            one line each, with its enabled state — replaces
+            guess-the-path (docs/observability.md)."""
+            surfaces = [
+                {
+                    "path": "/debug/traces",
+                    "enabled": True,
+                    "description": (
+                        "flight recorder: recent/slow/errored sampled "
+                        "traces (?kind=, ?limit=; /debug/traces/<id> "
+                        "for full spans)"
+                    ),
+                },
+                {
+                    "path": "/debug/cachestats",
+                    "enabled": indexer.cache_stats is not None,
+                    "description": (
+                        "hit-attribution ledger + index-truth audit "
+                        "plane (?top=, ?family=<hex>)"
+                    ),
+                },
+                {
+                    "path": "/debug/tiering",
+                    "enabled": tiering is not None,
+                    "description": (
+                        "predictive tiering: policy feed, advisor, "
+                        "eviction and demotion state"
+                    ),
+                },
+                {
+                    "path": "/debug/cluster",
+                    "enabled": cluster_status is not None,
+                    "description": (
+                        "replicated index: membership, ring, "
+                        "per-replica rpc fan-out attribution"
+                    ),
+                },
+                {
+                    "path": "/debug/slo",
+                    "enabled": slo is not None,
+                    "description": (
+                        "SLO engine: per-SLI burn rates and the "
+                        "degradation envelope"
+                    ),
+                },
+                {
+                    # Enabled means the SAMPLER is live-able (wired
+                    # AND PROFILE_HZ > 0) — a wired-but-off profiler
+                    # must read disabled or the index lies exactly
+                    # when the plane is off.  ?kind=locks stays
+                    # served regardless (contention timing is armed
+                    # by LOCK_CONTENTION_SAMPLE, not the sampler).
+                    "path": "/debug/profile",
+                    "enabled": (
+                        profiler is not None and profiler.config.hz > 0
+                    ),
+                    "description": (
+                        "continuous sampling profiler: top self-time "
+                        "table (?kind=top), collapsed flamegraph "
+                        "stacks (?kind=stacks), lock contention "
+                        "(?kind=locks — served even with the sampler "
+                        "off)"
+                    ),
+                },
+                {
+                    "path": "/debug/timeline",
+                    "enabled": (
+                        timeline is not None and timeline.window_s > 0
+                    ),
+                    "description": (
+                        "1s-resolution gauge history rings "
+                        "(?last=<seconds>, ?series=<name>)"
+                    ),
+                },
+            ]
+            self._reply_json(
+                200,
+                {
+                    "surfaces": surfaces,
+                    "also": ["/metrics", "/healthz"],
+                },
+            )
+
+        def _debug_profile(self, query):
+            """Read-only continuous-profiling plane: the sampling
+            profiler's top/collapsed views and the lock-contention
+            table (docs/observability.md "Continuous profiling")."""
+            kind = query.get("kind", "top")
+            if kind == "locks":
+                # The contention table is module-global lockorder
+                # state, armed by LOCK_CONTENTION_SAMPLE — it answers
+                # regardless of the sampler (or a profiler being
+                # wired at all).
+                from llm_d_kv_cache_manager_tpu.utils import lockorder
+
+                self._reply_json(
+                    200,
+                    {
+                        "sample": lockorder.contention_sample(),
+                        "locks": lockorder.contention_stats(),
+                    },
+                )
+                return
+            if profiler is None or profiler.config.hz <= 0:
+                self._error(
+                    404, "profiler disabled (set PROFILE_HZ > 0)"
+                )
+                return
+            if kind == "stacks":
+                # The standard collapsed/folded format — pipe into
+                # flamegraph.pl or paste into speedscope.
+                self._reply(
+                    200,
+                    profiler.collapsed().encode(),
+                    "text/plain; charset=utf-8",
+                )
+                return
+            if kind != "top":
+                self._error(400, "kind must be one of top|stacks|locks")
+                return
+            try:
+                top = max(1, min(int(query.get("top", "30")), 500))
+            except ValueError:
+                self._error(400, "invalid 'top'")
+                return
+            self._reply_json(200, profiler.status(top=top))
+
+        def _debug_timeline(self, query):
+            """Read-only gauge timelines: the 1s ring history that
+            walks a burn-rate alert back to the minutes before it
+            fired (docs/observability.md "Gauge timelines")."""
+            if timeline is None or timeline.window_s <= 0:
+                self._error(
+                    404, "timeline disabled (set TIMELINE_WINDOW_S > 0)"
+                )
+                return
+            last_s = None
+            raw_last = query.get("last")
+            if raw_last is not None:
+                try:
+                    last_s = max(0.0, float(raw_last))
+                except ValueError:
+                    self._error(400, "invalid 'last'")
+                    return
+            self._reply_json(
+                200,
+                timeline.snapshot(
+                    last_s=last_s, series=query.get("series")
+                ),
+            )
 
         def _debug_slo(self):
             """Read-only degradation envelopes: per-SLI state, burn
@@ -698,6 +858,17 @@ def _make_handler(
     return Handler
 
 
+class _NamedThreadingHTTPServer(http.server.ThreadingHTTPServer):
+    """ThreadingHTTPServer whose per-connection handler threads carry
+    the stable ``kvtpu-http-handler`` role name instead of the stock
+    anonymous ``Thread-N`` — the profiler attributes request-handling
+    wall time by it (docs/observability.md "Thread roles")."""
+
+    def process_request_thread(self, request, client_address):
+        threading.current_thread().name = "kvtpu-http-handler"
+        super().process_request_thread(request, client_address)
+
+
 def serve(
     indexer: Indexer,
     host: str = "0.0.0.0",
@@ -711,6 +882,8 @@ def serve(
     replica=None,
     cluster_status=None,
     slo=None,
+    profiler=None,
+    timeline=None,
 ) -> http.server.ThreadingHTTPServer:
     """Start the HTTP service on a background thread; returns the server
     (call ``.shutdown()`` to stop).  ``admin_token`` (env:
@@ -729,9 +902,12 @@ def serve(
     ``POST /replica`` RPC surface and ``cluster_status`` (a zero-arg
     callable) backs ``GET /debug/cluster`` (docs/replication.md);
     ``slo`` (an ``obs.slo.SloEngine``) backs ``GET /debug/slo`` and
-    the ``/healthz`` degradation-envelope block
+    the ``/healthz`` degradation-envelope block; ``profiler`` (an
+    ``obs.SamplingProfiler``) backs ``GET /debug/profile`` and
+    ``timeline`` (an ``obs.GaugeTimeline``) ``GET /debug/timeline``
+    — ``GET /debug/`` indexes every surface
     (docs/observability.md)."""
-    server = http.server.ThreadingHTTPServer(
+    server = _NamedThreadingHTTPServer(
         (host, port),
         _make_handler(
             indexer,
@@ -744,10 +920,14 @@ def serve(
             replica=replica,
             cluster_status=cluster_status,
             slo=slo,
+            profiler=profiler,
+            timeline=timeline,
         ),
     )
     thread = threading.Thread(
-        target=server.serve_forever, name="http-service", daemon=True
+        target=server.serve_forever,
+        name="kvtpu-http-service",
+        daemon=True,
     )
     thread.start()
     logger.info("http scoring service listening on %s:%d", host, port)
@@ -1141,6 +1321,32 @@ def main() -> None:  # pragma: no cover - CLI entry
         float(os.environ.get("METRICS_LOGGING_INTERVAL", "60"))
     )
 
+    # Continuous profiling plane (docs/observability.md): the
+    # always-on sampling profiler (PROFILE_HZ, 0 = fully inert), gc
+    # pause accounting, and the 1s gauge timeline rings
+    # (TIMELINE_WINDOW_S, 0 disables) feeding /debug/profile and
+    # /debug/timeline.  Lock-contention timing arms itself from
+    # LOCK_CONTENTION_SAMPLE at lock construction (utils/lockorder.py).
+    from llm_d_kv_cache_manager_tpu.metrics.collector import (
+        install_gc_metrics,
+    )
+    from llm_d_kv_cache_manager_tpu.obs.profiler import PROFILER
+    from llm_d_kv_cache_manager_tpu.obs.timeline import (
+        GaugeTimeline,
+        register_default_series,
+    )
+
+    install_gc_metrics()
+    PROFILER.start()
+    timeline = GaugeTimeline()
+    register_default_series(
+        timeline,
+        pool=pool,
+        remote_index=cluster_remote_index,
+        resync=resync,
+    )
+    timeline.start()
+
     # SLO_ENABLE (default on) attaches the degradation-envelope engine
     # (obs/slo.py): the stock fleet SLIs are fed from existing metric
     # surfaces, evaluated over a fast and a slow window, and published
@@ -1196,6 +1402,8 @@ def main() -> None:  # pragma: no cover - CLI entry
         replica=cluster_replica,
         cluster_status=cluster_status,
         slo=slo_engine,
+        profiler=PROFILER,
+        timeline=timeline,
     )
     try:
         threading.Event().wait()
@@ -1203,6 +1411,8 @@ def main() -> None:  # pragma: no cover - CLI entry
         pass
     finally:
         stop_beat.set()
+        timeline.close()
+        PROFILER.close()
         if slo_engine is not None:
             slo_engine.close()
         if stop_snapshots is not None:

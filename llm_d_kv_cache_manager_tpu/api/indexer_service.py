@@ -83,7 +83,10 @@ def serve(
     """Build+start a server; returns it (caller owns lifetime)."""
     if server is None:
         server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers)
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers,
+                thread_name_prefix="kvtpu-grpc",
+            )
         )
     add_indexer_servicer(IndexerGrpcService(indexer), server)
     server.add_insecure_port(address)

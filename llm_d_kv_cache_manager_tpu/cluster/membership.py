@@ -231,7 +231,7 @@ class HeartbeatMonitor:
         if self._thread is not None:
             return
         self._thread = threading.Thread(
-            target=self._run, name="cluster-heartbeat", daemon=True
+            target=self._run, name="kvtpu-cluster-heartbeat", daemon=True
         )
         self._thread.start()
 

@@ -114,6 +114,13 @@ class RemoteIndex(Index):
         )
         self._rpc_tallies: Dict[str, dict] = {}  # guarded-by: _stats_lock
         self._reroutes = 0  # guarded-by: _stats_lock
+        # Outstanding transport calls right now — the timeline's
+        # cluster_rpc_in_flight series (obs/timeline.py).  Locked,
+        # unlike the shard version counters: those only ever advance
+        # (a lost bump merely lags), but a PAIRED inc/dec gauge
+        # drifts permanently on one lost store.  Two leaf-lock ops
+        # per RPC are noise next to the transport call itself.
+        self._in_flight = 0  # guarded-by: _stats_lock
         self._lookup_calls = 0  # guarded-by: _stats_lock
         self._lookup_owner_rpcs = 0  # guarded-by: _stats_lock
         self._lookup_owner_max = 0  # guarded-by: _stats_lock
@@ -248,42 +255,57 @@ class RemoteIndex(Index):
         ambient = current_trace()
         trace = ambient if self.trace_rpcs else None
         start = time.perf_counter()
+        with self._stats_lock:
+            self._in_flight += 1
         try:
-            if trace is None:
-                if ambient is not None:
-                    # trace_rpcs off with a live trace: shield the
-                    # in-process transport so the replica's direct
-                    # context-var record cannot leak orphan replica.*
-                    # spans under a cluster.rpc parent that was never
-                    # opened — the knob disables the WHOLE plane.
-                    with shield_trace():
+            try:
+                if trace is None:
+                    if ambient is not None:
+                        # trace_rpcs off with a live trace: shield the
+                        # in-process transport so the replica's direct
+                        # context-var record cannot leak orphan
+                        # replica.* spans under a cluster.rpc parent
+                        # that was never opened — the knob disables
+                        # the WHOLE plane.
+                        with shield_trace():
+                            result = transport.call(method, args)
+                    else:
                         result = transport.call(method, args)
                 else:
-                    result = transport.call(method, args)
-            else:
-                result = self._call_traced(
-                    trace, transport, replica_id, method, args, start
+                    result = self._call_traced(
+                        trace, transport, replica_id, method, args,
+                        start,
+                    )
+            except (ReplicaUnavailable, ConnectionError, OSError) as exc:
+                elapsed = time.perf_counter() - start
+                kind = getattr(exc, "kind", None) or "io"
+                METRICS.cluster_rpc_errors.labels(
+                    replica=safe_label(replica_id),
+                    kind=safe_label(kind),
+                ).inc()
+                if self.rpc_accounting:
+                    self._tally(
+                        replica_id, method, elapsed,
+                        error=(kind, str(exc)),
+                    )
+                self.membership.mark_dead(
+                    replica_id, f"{method} failed: {exc}"
                 )
-        except (ReplicaUnavailable, ConnectionError, OSError) as exc:
-            elapsed = time.perf_counter() - start
-            kind = getattr(exc, "kind", None) or "io"
-            METRICS.cluster_rpc_errors.labels(
-                replica=safe_label(replica_id),
-                kind=safe_label(kind),
-            ).inc()
-            if self.rpc_accounting:
-                self._tally(
-                    replica_id, method, elapsed, error=(kind, str(exc))
-                )
-            self.membership.mark_dead(
-                replica_id, f"{method} failed: {exc}"
-            )
-            raise ReplicaUnavailable(str(exc), kind=kind) from exc
+                raise ReplicaUnavailable(str(exc), kind=kind) from exc
+        finally:
+            with self._stats_lock:
+                self._in_flight -= 1
         elapsed = time.perf_counter() - start
         self._rpc_latency(method).observe(elapsed)
         if self.rpc_accounting:
             self._tally(replica_id, method, elapsed)
         return result
+
+    def in_flight(self) -> int:
+        """Transport calls currently outstanding (gauge; see
+        obs/timeline.py's cluster_rpc_in_flight series)."""
+        with self._stats_lock:
+            return self._in_flight
 
     def _call_routed(self, key: int, method: str, args: list):
         """Single-key op with failover re-route."""
@@ -427,6 +449,7 @@ class RemoteIndex(Index):
             lookups = self._lookup_calls
             return {
                 "replicas": replicas,
+                "in_flight": self._in_flight,
                 "reroutes": self._reroutes,
                 "critical_path": {
                     "lookup_calls": lookups,

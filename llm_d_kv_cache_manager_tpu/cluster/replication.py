@@ -331,7 +331,7 @@ class ReplicationFollower:
             return
         self._thread = threading.Thread(
             target=self._run,
-            name=f"cluster-follow-{self.peer_id}",
+            name=f"kvtpu-cluster-follow-{self.peer_id}",
             daemon=True,
         )
         self._thread.start()

@@ -317,7 +317,7 @@ class PodReconciler:
         if self._thread is not None:
             return
         self._thread = threading.Thread(
-            target=self._loop, name="pod-reconciler", daemon=True
+            target=self._loop, name="kvtpu-pod-reconciler", daemon=True
         )
         self._thread.start()
 

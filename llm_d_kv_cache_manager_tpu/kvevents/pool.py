@@ -527,6 +527,12 @@ class _ShardQueue:
         with self._lock:
             return self._size
 
+    def lane_stats(self) -> Tuple[int, int]:
+        """(queued regular messages, live lanes) — the timeline's
+        shard-backlog/lane series (docs/observability.md)."""
+        with self._lock:
+            return self._size, len(self._lanes)
+
     def snapshot(self) -> List[Message]:
         """Queued messages in drain (round-robin) order — tests only."""
         with self._lock:
@@ -823,6 +829,28 @@ class Pool:
         bottleneck attribution (docs/event-plane.md)."""
         with self._stage_lock:
             return dict(self._stage)
+
+    def lane_stats(self) -> Tuple[int, int]:
+        """(queued-not-applied messages, pods holding a live lane)
+        across every shard in ONE walk — the timeline samples both
+        series every second off a single call, so the shard locks
+        are taken once, not once per series (shards are sampled one
+        lock at a time: a near-instant, not atomic, view)."""
+        queued = 0
+        lanes = 0
+        for q in self._queues:
+            shard_queued, shard_lanes = q.lane_stats()
+            queued += shard_queued
+            lanes += shard_lanes
+        return queued, lanes
+
+    def backlog(self) -> int:
+        """Queued-not-applied messages across every shard."""
+        return self.lane_stats()[0]
+
+    def lane_count(self) -> int:
+        """Pods holding a live (non-empty) lane across every shard."""
+        return self.lane_stats()[1]
 
     def _prepare_message(self, message: Message) -> None:
         if message.trace is None:

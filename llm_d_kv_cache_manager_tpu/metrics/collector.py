@@ -9,7 +9,10 @@ so embedding applications can mount ``/metrics`` wherever they like.
 
 from __future__ import annotations
 
+import gc
+import os
 import threading
+import time
 from typing import Optional
 
 from prometheus_client import (
@@ -424,6 +427,64 @@ class KVCacheMetrics:
             ("sli", "window"),
             registry=self.registry,
         )
+        # Lock-contention telemetry (utils/lockorder.py timing mode;
+        # docs/observability.md "Lock contention").  Only contended
+        # sampled acquires land here — with LOCK_CONTENTION_SAMPLE
+        # unset/0 both families stay empty.
+        self.lock_wait = Histogram(
+            f"{_NAMESPACE}_lock_wait_seconds",
+            "Wait time of contended sampled acquires per tracked lock "
+            "name (LOCK_CONTENTION_SAMPLE gates the probe rate).",
+            ("lock",),
+            registry=self.registry,
+            buckets=(
+                0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
+                0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 1.0,
+            ),
+        )
+        self.lock_contention = Counter(
+            f"{_NAMESPACE}_lock_contention_total",
+            "Contended sampled acquires per tracked lock name (the "
+            "non-blocking probe failed; the acquire had to wait).",
+            ("lock",),
+            registry=self.registry,
+        )
+        # Process runtime gauges (refreshed by update_process_metrics:
+        # the metrics beat and the gauge timeline both call it).
+        self.process_rss = Gauge(
+            f"{_NAMESPACE}_process_rss_bytes",
+            "Resident set size of this process (/proc/self/statm).",
+            registry=self.registry,
+        )
+        self.process_open_fds = Gauge(
+            f"{_NAMESPACE}_process_open_fds",
+            "Open file descriptors of this process (/proc/self/fd).",
+            registry=self.registry,
+        )
+        self.process_threads = Gauge(
+            f"{_NAMESPACE}_process_threads",
+            "Live Python threads (threading.active_count()).",
+            registry=self.registry,
+        )
+        self.gc_collections = Counter(
+            f"{_NAMESPACE}_gc_collections_total",
+            "Garbage-collection passes by generation (gc callbacks; "
+            "install_gc_metrics()).",
+            ("gen",),
+            registry=self.registry,
+        )
+        self.gc_pause = Histogram(
+            f"{_NAMESPACE}_gc_pause_seconds",
+            "Wall time of each garbage-collection pass (the collecting "
+            "thread is stalled for the duration; every other thread "
+            "contends for the GIL against it).",
+            registry=self.registry,
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 1.0,
+            ),
+        )
         # Per-stage latencies fed by the tracing subsystem (obs/trace.py):
         # every span of a sampled trace lands here under its span name, so
         # the aggregate view and the per-request flight-recorder view
@@ -503,6 +564,95 @@ def gauge_value(gauge: Gauge) -> float:
     return 0.0
 
 
+def gauge_total(gauge: Gauge) -> float:
+    """Sum of a labeled gauge's samples across all label sets (e.g.
+    total event backlog over the per-pod ``kvevents_pod_backlog``
+    series); 0.0 with no children yet."""
+    total = 0.0
+    for metric in gauge.collect():
+        for sample in metric.samples:
+            total += sample.value
+    return total
+
+
+# ------------------------ process runtime metrics ------------------------
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def update_process_metrics() -> dict:
+    """Refresh the process runtime gauges and return their values.
+
+    Called by the metrics beat and by the gauge timeline's sampler
+    (obs/timeline.py) — cheap by construction: two /proc reads and a
+    thread count, no allocation-heavy walks.  Platforms without /proc
+    (macOS dev boxes) report what they can and leave the rest at 0.
+    """
+    out = {"rss_bytes": 0.0, "open_fds": 0.0, "threads": 0.0}
+    try:
+        with open("/proc/self/statm", "rb") as statm:
+            out["rss_bytes"] = float(
+                int(statm.read().split()[1]) * _PAGE_SIZE
+            )
+    except (OSError, ValueError, IndexError):
+        pass  # kvlint: disable=KV005 — no /proc: gauge stays 0
+    try:
+        out["open_fds"] = float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass  # kvlint: disable=KV005 — no /proc: gauge stays 0
+    out["threads"] = float(threading.active_count())
+    METRICS.process_rss.set(out["rss_bytes"])
+    METRICS.process_open_fds.set(out["open_fds"])
+    METRICS.process_threads.set(out["threads"])
+    return out
+
+
+# gc callbacks run on whichever thread triggered the collection, and
+# CPython serializes collections — a per-generation start stamp keyed
+# by generation is race-free without a lock.
+_gc_starts: dict = {}
+_gc_installed = False
+_gc_children: dict = {}
+
+
+def _gc_callback(phase: str, info: dict) -> None:
+    gen = info.get("generation", 0)
+    if phase == "start":
+        _gc_starts[gen] = time.perf_counter()
+        return
+    start = _gc_starts.pop(gen, None)
+    child = _gc_children.get(gen)
+    if child is None:
+        child = METRICS.gc_collections.labels(gen=str(gen))
+        _gc_children[gen] = child
+    child.inc()
+    if start is not None:
+        METRICS.gc_pause.observe(time.perf_counter() - start)
+
+
+def install_gc_metrics() -> bool:
+    """Hook ``gc.callbacks`` so every collection pass lands in
+    ``kvtpu_gc_collections_total{gen}`` / ``kvtpu_gc_pause_seconds``.
+    Idempotent; returns True when (already) installed."""
+    global _gc_installed
+    if _gc_installed:
+        return True
+    gc.callbacks.append(_gc_callback)
+    _gc_installed = True
+    return True
+
+
+def uninstall_gc_metrics() -> None:
+    """Remove the gc hook (test isolation)."""
+    global _gc_installed
+    if _gc_installed:
+        try:
+            gc.callbacks.remove(_gc_callback)
+        except ValueError:
+            logger.warning("gc metrics callback already removed")
+        _gc_installed = False
+
+
 def start_metrics_logging(interval_seconds: float = 60.0) -> threading.Event:
     """Log a periodic one-line metrics beat; returns a stop event."""
     stop = threading.Event()
@@ -512,16 +662,24 @@ def start_metrics_logging(interval_seconds: float = 60.0) -> threading.Event:
             # dropped_events and journal_lag earn their place on the
             # line during incidents: a climbing drop count means event
             # shards are shedding (stale index), a climbing lag means a
-            # crash right now replays that many journal records.
+            # crash right now replays that many journal records.  The
+            # process block (rss/fds/threads/gc) is the leak telltale:
+            # those climb for minutes before anything else degrades.
+            proc = update_process_metrics()
             logger.info(
                 "metrics beat: admissions=%d evictions=%d lookups=%d "
-                "hits=%d dropped_events=%d journal_lag=%d",
+                "hits=%d dropped_events=%d journal_lag=%d rss_mb=%.1f "
+                "fds=%d threads=%d gc=%d",
                 counter_total(METRICS.index_admissions),
                 counter_total(METRICS.index_evictions),
                 counter_total(METRICS.index_lookup_requests),
                 counter_total(METRICS.index_lookup_hits),
                 counter_total(METRICS.kvevents_dropped),
                 gauge_value(METRICS.persistence_journal_lag),
+                proc["rss_bytes"] / 1e6,
+                proc["open_fds"],
+                proc["threads"],
+                counter_total(METRICS.gc_collections),
             )
 
     thread = threading.Thread(target=beat, name="kvtpu-metrics-beat", daemon=True)

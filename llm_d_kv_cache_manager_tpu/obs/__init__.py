@@ -7,7 +7,17 @@ See docs/observability.md.  Import surface:
     )
 """
 
+from llm_d_kv_cache_manager_tpu.obs.profiler import (
+    PROFILER,
+    ProfilerConfig,
+    SamplingProfiler,
+    thread_role,
+)
 from llm_d_kv_cache_manager_tpu.obs.recorder import FlightRecorder
+from llm_d_kv_cache_manager_tpu.obs.timeline import (
+    GaugeTimeline,
+    register_default_series,
+)
 from llm_d_kv_cache_manager_tpu.obs.slo import (
     SloEngine,
     SloSpec,
@@ -30,6 +40,12 @@ from llm_d_kv_cache_manager_tpu.obs.trace import (
 
 __all__ = [
     "FlightRecorder",
+    "GaugeTimeline",
+    "PROFILER",
+    "ProfilerConfig",
+    "SamplingProfiler",
+    "register_default_series",
+    "thread_role",
     "SloEngine",
     "SloSpec",
     "default_fleet_slos",

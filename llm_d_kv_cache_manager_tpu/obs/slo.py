@@ -478,7 +478,7 @@ class SloEngine:
                     logger.exception("SLO evaluation round failed")
 
         self._thread = threading.Thread(
-            target=run, name="slo-engine", daemon=True
+            target=run, name="kvtpu-slo-engine", daemon=True
         )
         self._thread.start()
 

@@ -326,7 +326,8 @@ def serve(
         os.unlink(uds_path)
     server = grpc.server(
         futures.ThreadPoolExecutor(
-            max_workers=max_workers or os.cpu_count() or 4
+            max_workers=max_workers or os.cpu_count() or 4,
+            thread_name_prefix="kvtpu-uds-tokenizer",
         ),
         options=[
             ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),

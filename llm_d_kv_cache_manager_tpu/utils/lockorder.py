@@ -32,22 +32,41 @@ Checks fire on acquire, against a per-thread stack of held locks:
 
 Violations raise :class:`LockOrderViolation` (an ``AssertionError``
 subclass, so storm tests fail loudly instead of deadlocking flakily).
+
+Contention telemetry (docs/observability.md "Lock contention"): the
+second, production-grade mode of :func:`tracked`.  Unlike the
+watchdog it needs no debug flag — setting ``LOCK_CONTENTION_SAMPLE=N``
+arms it in any build: every Nth acquire of a tracked lock runs a
+non-blocking probe first; a probe that succeeds costs nothing beyond
+the probe itself (the uncontended fast path stays ~free), a probe
+that fails is a *contended* acquire whose wait is timed and folded
+into a per-lock-name stat (count, EWMA, max, total) plus the
+``kvtpu_lock_wait_seconds{lock}`` / ``kvtpu_lock_contention_total
+{lock}`` metric families.  ``LOCK_CONTENTION_SAMPLE`` unset or ``0``
+keeps today's behavior bit-identically: :func:`tracked` returns the
+raw lock object.  The watchdog supersedes timing when both are armed
+(it is a debug tool; timing is for production).
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from typing import List, Optional, Set, Tuple
+import time
+from typing import Dict, List, Optional, Set, Tuple
 
 __all__ = [
     "LockOrderViolation",
+    "contention_sample",
+    "contention_stats",
     "declare_ascending",
     "declare_order",
     "enable",
     "enabled",
     "held",
+    "reset_contention_stats",
     "reset_declarations",
+    "set_contention_sample",
     "tracked",
 ]
 
@@ -204,14 +223,216 @@ class TrackedLock:
         return getattr(self._lock, attr)
 
 
+# ------------------------ contention telemetry ------------------------
+
+
+def _env_sample() -> int:
+    raw = os.environ.get("LOCK_CONTENTION_SAMPLE", "")
+    if not raw:
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 0
+
+
+# 0 = off (tracked() returns the raw lock); N>0 = probe every Nth
+# acquire of locks constructed after arming.  Mutated only by
+# set_contention_sample() (tests/smokes) — read at lock construction.
+_contention_sample = _env_sample()
+
+_EWMA_ALPHA = 0.2
+
+_contention_lock = threading.Lock()
+_contention: Dict[str, "_ContentionStat"] = {}  # guarded-by: _contention_lock
+
+
+class _ContentionStat:
+    """Aggregate for one lock *name* (all instances fold together).
+
+    ``sampled`` is bumped lock-free from the probe fast path — a plain
+    int increment is GIL-coherent enough for a statistic, and putting
+    a global lock on every Nth acquire of every tracked lock would
+    manufacture exactly the contention this mode exists to find.  The
+    contended-path fields are updated under ``_contention_lock``
+    (that path just finished *waiting*; a lock op is noise there).
+    """
+
+    __slots__ = (
+        "name",
+        "sampled",
+        "contended",
+        "wait_total_s",
+        "wait_max_s",
+        "wait_ewma_s",
+        "_wait_hist",
+        "_contended_counter",
+    )
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.sampled = 0  # lock-free statistic (see class docstring)
+        # The wait fields are updated/read under the MODULE-level
+        # _contention_lock (record_contended/view) — KV001's
+        # guarded-by annotation only resolves instance locks, so the
+        # discipline is documented here instead.
+        self.contended = 0
+        self.wait_total_s = 0.0
+        self.wait_max_s = 0.0
+        self.wait_ewma_s = 0.0
+        # Lazy import: lockorder must stay importable (and ~free) in
+        # contexts that never arm timing and never touch prometheus.
+        from llm_d_kv_cache_manager_tpu.metrics.collector import METRICS
+
+        self._wait_hist = METRICS.lock_wait.labels(lock=name)
+        self._contended_counter = METRICS.lock_contention.labels(
+            lock=name
+        )
+
+    def record_contended(self, wait_s: float) -> None:
+        with _contention_lock:
+            self.contended += 1
+            self.wait_total_s += wait_s
+            if wait_s > self.wait_max_s:
+                self.wait_max_s = wait_s
+            if self.wait_ewma_s == 0.0:
+                self.wait_ewma_s = wait_s
+            else:
+                self.wait_ewma_s += _EWMA_ALPHA * (
+                    wait_s - self.wait_ewma_s
+                )
+        self._contended_counter.inc()
+        self._wait_hist.observe(wait_s)
+
+    def view(self) -> dict:
+        with _contention_lock:
+            contended = self.contended
+            view = {
+                "sampled": self.sampled,
+                "contended": contended,
+                "wait_total_ms": round(self.wait_total_s * 1e3, 3),
+                "wait_max_us": round(self.wait_max_s * 1e6, 1),
+                "wait_ewma_us": round(self.wait_ewma_s * 1e6, 1),
+            }
+        sampled = view["sampled"]
+        view["contention_ratio"] = (
+            round(contended / sampled, 4) if sampled else 0.0
+        )
+        return view
+
+
+def _stat_for(name: str) -> _ContentionStat:
+    stat = _contention.get(name)
+    if stat is not None:
+        return stat
+    # Construct OUTSIDE _contention_lock: the prometheus labels()
+    # call takes the registry lock, and nesting a foreign lock under
+    # ours is exactly the shape KV006 exists to forbid.  A racing
+    # constructor loses to setdefault and its stat is garbage.
+    stat = _ContentionStat(name)
+    with _contention_lock:
+        return _contention.setdefault(name, stat)
+
+
+def contention_sample() -> int:
+    """The armed sampling interval (0 = timing off)."""
+    return _contention_sample
+
+
+def set_contention_sample(sample: int) -> int:
+    """Arm/disarm contention timing (tests, smokes); returns the
+    previous interval.  Like :func:`enable`, only locks created by
+    :func:`tracked` *after* the call pick the new mode up."""
+    global _contention_sample
+    previous = _contention_sample
+    _contention_sample = max(0, int(sample))
+    return previous
+
+
+def contention_stats() -> Dict[str, dict]:
+    """Per-lock-name contention view (the ``/debug/profile?kind=locks``
+    payload): sampled/contended counts, contention ratio, wait EWMA /
+    max / total."""
+    with _contention_lock:
+        stats = list(_contention.values())
+    return {stat.name: stat.view() for stat in stats}
+
+
+def reset_contention_stats() -> None:
+    """Drop every accumulated stat (test/bench isolation).  Locks
+    already constructed keep feeding their (now orphaned) stat
+    objects; re-create structures after resetting, same as
+    :func:`enable`."""
+    with _contention_lock:
+        _contention.clear()
+
+
+class ContentionTimedLock:
+    """Contention-timing proxy over a ``threading`` lock primitive.
+
+    Every ``sample``-th acquire runs a non-blocking probe; only a
+    failed probe (a genuinely contended acquire) pays for timestamps
+    and stat recording.  Everything else proxies straight through,
+    and non-acquire surface (``locked``, Condition ``wait``/``notify``)
+    falls through via ``__getattr__`` exactly like ``TrackedLock``.
+    """
+
+    __slots__ = ("_lock", "_stat", "_sample", "_tick")
+
+    def __init__(self, lock, stat: _ContentionStat, sample: int) -> None:
+        self._lock = lock
+        self._stat = stat
+        self._sample = sample
+        self._tick = 0
+
+    @property
+    def name(self) -> str:
+        return self._stat.name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._tick += 1
+        if self._tick % self._sample:
+            return self._lock.acquire(blocking, timeout)
+        stat = self._stat
+        stat.sampled += 1  # lock-free statistic (see _ContentionStat)
+        if self._lock.acquire(False):
+            return True
+        if not blocking:
+            # The probe WAS the caller's non-blocking attempt; a
+            # failed one still proves contention.
+            stat.record_contended(0.0)
+            return False
+        start = time.perf_counter()
+        acquired = self._lock.acquire(blocking, timeout)
+        stat.record_contended(time.perf_counter() - start)
+        return acquired
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.release()
+
+    def __getattr__(self, attr):
+        return getattr(self._lock, attr)
+
+
 def tracked(lock, name: str, rank: Optional[int] = None):
-    """Wrap ``lock`` for order checking — identity when the watchdog
-    is off, so the production fast path never pays for it.
+    """Wrap ``lock`` for order checking or contention timing —
+    identity when both modes are off, so the production fast path
+    never pays for it.
 
     ``name`` should match the static model's lock identity
     (``Class._attr``); ``rank`` disambiguates instances under an
     ``ascending`` declaration (e.g. the shard index).
     """
-    if not _enabled:
-        return lock
-    return TrackedLock(lock, name, rank)
+    if _enabled:
+        return TrackedLock(lock, name, rank)
+    sample = _contention_sample
+    if sample > 0:
+        return ContentionTimedLock(lock, _stat_for(name), sample)
+    return lock

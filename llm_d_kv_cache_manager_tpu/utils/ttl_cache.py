@@ -131,7 +131,7 @@ class TTLCache(Generic[K, V]):
                 self.sweep()
 
         self._sweeper = threading.Thread(
-            target=loop, name="ttl-cache-sweeper", daemon=True
+            target=loop, name="kvtpu-ttl-sweeper", daemon=True
         )
         self._sweeper.start()
 

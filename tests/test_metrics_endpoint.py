@@ -33,9 +33,13 @@ from llm_d_kv_cache_manager_tpu.metrics.collector import (
     MAX_LABEL_LEN,
     METRICS,
     counter_total,
+    gauge_total,
     gauge_value,
+    install_gc_metrics,
     safe_label,
     start_metrics_logging,
+    uninstall_gc_metrics,
+    update_process_metrics,
 )
 from llm_d_kv_cache_manager_tpu.tokenization.pool import (
     TokenizationPoolConfig,
@@ -294,3 +298,62 @@ class TestCollectorHelpers:
         assert records, "beat never fired"
         assert "dropped_events=" in records[0]
         assert "journal_lag=" in records[0]
+        # The process block rides the same line (ISSUE 14: the leak
+        # telltales climb minutes before anything else degrades).
+        assert "rss_mb=" in records[0]
+        assert "threads=" in records[0]
+        assert "gc=" in records[0]
+
+    def test_gauge_total_sums_labeled_gauge(self):
+        registry = CollectorRegistry()
+        gauge = Gauge("t_backlog", "d.", ("pod",), registry=registry)
+        assert gauge_total(gauge) == 0.0
+        gauge.labels(pod="a").set(3)
+        gauge.labels(pod="b").set(4)
+        assert gauge_total(gauge) == 7.0
+
+
+class TestProcessRuntimeMetrics:
+    def test_update_sets_gauges(self):
+        values = update_process_metrics()
+        # Linux CI/dev boxes have /proc; the gauges mirror the dict.
+        assert values["rss_bytes"] > 0
+        assert values["open_fds"] > 0
+        assert values["threads"] >= 1
+        assert gauge_value(METRICS.process_rss) == values["rss_bytes"]
+        assert gauge_value(METRICS.process_threads) == values["threads"]
+
+    def test_gc_callbacks_count_collections(self):
+        import gc
+
+        assert install_gc_metrics()
+        assert install_gc_metrics()  # idempotent
+        try:
+            before = counter_total(METRICS.gc_collections)
+            pause_before = METRICS.gc_pause.collect()[0].samples
+            gc.collect()
+            after = counter_total(METRICS.gc_collections)
+            assert after > before
+            # The pause histogram observed the pass (its _count grew).
+            def hist_count(samples):
+                return sum(
+                    s.value
+                    for s in samples
+                    if s.name.endswith("_count")
+                )
+
+            assert hist_count(
+                METRICS.gc_pause.collect()[0].samples
+            ) > hist_count(pause_before)
+            # Generation label rides the forced full collection.
+            text = METRICS.exposition().decode()
+            assert 'kvtpu_gc_collections_total{gen="2"}' in text
+        finally:
+            uninstall_gc_metrics()
+
+    def test_process_gauges_exposed(self):
+        update_process_metrics()
+        text = METRICS.exposition().decode()
+        assert "kvtpu_process_rss_bytes" in text
+        assert "kvtpu_process_open_fds" in text
+        assert "kvtpu_process_threads" in text

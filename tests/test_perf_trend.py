@@ -1,0 +1,298 @@
+"""Perf-trend gate (hack/perf_trend.py; ISSUE 14 satellite).
+
+The acceptance contract directly: the tool passes on the repo's real
+BENCH_r01–r06 trajectory, fails on a synthetic regressed artifact,
+parses every artifact shape the trajectory contains (parsed /
+headline / compact), and skips errored runs as baselines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from hack.perf_trend import (
+    evaluate,
+    extract_headlines,
+    load_trajectory,
+    main,
+)
+
+REPO_ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _write(tmp_path, name: str, artifact: dict) -> None:
+    (tmp_path / name).write_text(json.dumps(artifact))
+
+
+class TestExtraction:
+    def test_parsed_shape(self):
+        headlines = extract_headlines(
+            {
+                "n": 1,
+                "rc": 0,
+                "parsed": {
+                    "metric": "p50_ttft_speedup_precise_vs_round_robin",
+                    "value": 4.457,
+                    "unit": "x",
+                },
+            }
+        )
+        assert headlines == {"ttft.speedup": 4.457}
+
+    def test_headline_regime_shape(self):
+        headlines = extract_headlines(
+            {
+                "n": 6,
+                "rc": 0,
+                "headline": {
+                    "regime": "event_storm",
+                    "apply_msgs_per_sec": 519.1,
+                    "consistency": 1.0,
+                },
+            }
+        )
+        assert headlines == {
+            "event_storm.apply_sps": 519.1,
+            "event_storm.consistency": 1.0,
+        }
+
+    def test_compact_shape_with_blocks(self):
+        headlines = extract_headlines(
+            {
+                "n": 7,
+                "rc": 0,
+                "compact": {
+                    "metric": "p50_ttft_speedup_precise_vs_round_robin",
+                    "value": 4.0,
+                    "read_path": {
+                        "warm_sps": 2800.0,
+                        "cold_sps": 90.0,
+                        "mixed_sps": 170.0,
+                    },
+                    "event_storm": {
+                        "apply_sps": 6000.0,
+                        "consistency": 1.0,
+                    },
+                    "replica_scaleout": {
+                        "single_sps": 2500.0,
+                        "cluster3_sps": 400.0,
+                    },
+                },
+            }
+        )
+        assert headlines["ttft.speedup"] == 4.0
+        assert headlines["read_path.warm_sps"] == 2800.0
+        assert headlines["event_storm.apply_sps"] == 6000.0
+        assert headlines["replica_scaleout.cluster3_sps"] == 400.0
+
+    def test_full_regime_cells_shape(self):
+        headlines = extract_headlines(
+            {
+                "rc": 0,
+                "read_path": {
+                    "warm_multi_turn": {"scores_per_sec": 2843.5}
+                },
+                "replica_scaleout": {
+                    "single": {"scores_per_sec": 2000.0},
+                    "cluster_3_replicas": {"scores_per_sec": 300.0},
+                },
+                "event_storm": {
+                    "consolidated_pollers_1": {
+                        "apply_msgs_per_sec": 519.1
+                    },
+                    "gap_storm": {"post_resync_consistency": 1.0},
+                },
+            }
+        )
+        assert headlines["read_path.warm_sps"] == 2843.5
+        assert headlines["replica_scaleout.single_sps"] == 2000.0
+        assert headlines["event_storm.apply_sps"] == 519.1
+        assert headlines["event_storm.consistency"] == 1.0
+
+    def test_errored_artifact_yields_nothing(self):
+        assert (
+            extract_headlines(
+                {
+                    "n": 4,
+                    "rc": 0,
+                    "parsed": {
+                        "metric": "p50_ttft_speedup_precise",
+                        "value": 0.0,
+                        "error": "device unavailable",
+                    },
+                }
+            )
+            == {}
+        )
+        assert extract_headlines({"n": 9, "rc": 1}) == {}
+
+
+class TestGate:
+    def test_passes_on_real_trajectory(self):
+        assert main(["--dir", REPO_ROOT]) == 0
+
+    def test_real_trajectory_has_headlines(self):
+        runs = load_trajectory(REPO_ROOT)
+        assert len(runs) >= 6
+        measured = {
+            key for _, _, headlines in runs for key in headlines
+        }
+        assert "ttft.speedup" in measured
+        assert "event_storm.apply_sps" in measured
+
+    def test_fails_on_synthetic_regression(self, tmp_path):
+        _write(
+            tmp_path,
+            "BENCH_r01.json",
+            {
+                "n": 1,
+                "rc": 0,
+                "headline": {
+                    "regime": "event_storm",
+                    "apply_msgs_per_sec": 500.0,
+                },
+            },
+        )
+        _write(
+            tmp_path,
+            "BENCH_r02.json",
+            {
+                "n": 2,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 400.0}},
+            },
+        )
+        assert main(["--dir", str(tmp_path)]) == 1
+
+    def test_within_threshold_passes(self, tmp_path):
+        _write(
+            tmp_path,
+            "BENCH_r01.json",
+            {
+                "n": 1,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 500.0}},
+            },
+        )
+        _write(
+            tmp_path,
+            "BENCH_r02.json",
+            {
+                "n": 2,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 460.0}},
+            },
+        )
+        assert main(["--dir", str(tmp_path)]) == 0
+
+    def test_errored_run_never_baselines(self, tmp_path):
+        # r2 is errored — the r3 value compares against r1, and a
+        # regression vs r1 still fails even with the errored run in
+        # between.
+        _write(
+            tmp_path,
+            "BENCH_r01.json",
+            {
+                "n": 1,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 500.0}},
+            },
+        )
+        _write(tmp_path, "BENCH_r02.json", {"n": 2, "rc": 1})
+        _write(
+            tmp_path,
+            "BENCH_r03.json",
+            {
+                "n": 3,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 100.0}},
+            },
+        )
+        assert main(["--dir", str(tmp_path)]) == 1
+
+    def test_headline_absent_from_newest_not_compared(self, tmp_path):
+        _write(
+            tmp_path,
+            "BENCH_r01.json",
+            {
+                "n": 1,
+                "rc": 0,
+                "compact": {"read_path": {"warm_sps": 9000.0}},
+            },
+        )
+        _write(
+            tmp_path,
+            "BENCH_r02.json",
+            {
+                "n": 2,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 100.0}},
+            },
+        )
+        assert main(["--dir", str(tmp_path)]) == 0
+
+    def test_unreadable_artifact_skipped(self, tmp_path):
+        (tmp_path / "BENCH_r01.json").write_text("{not json")
+        _write(
+            tmp_path,
+            "BENCH_r02.json",
+            {
+                "n": 2,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 100.0}},
+            },
+        )
+        assert main(["--dir", str(tmp_path)]) == 0
+
+    def test_empty_directory_passes(self, tmp_path):
+        assert main(["--dir", str(tmp_path)]) == 0
+
+    def test_custom_threshold(self, tmp_path):
+        _write(
+            tmp_path,
+            "BENCH_r01.json",
+            {
+                "n": 1,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 500.0}},
+            },
+        )
+        _write(
+            tmp_path,
+            "BENCH_r02.json",
+            {
+                "n": 2,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 460.0}},
+            },
+        )
+        # 8% drop: inside the default gate, outside a 5% one.
+        assert main(["--dir", str(tmp_path)]) == 0
+        assert (
+            main(["--dir", str(tmp_path), "--threshold", "0.05"]) == 1
+        )
+
+    def test_table_marks_regression(self, tmp_path):
+        _write(
+            tmp_path,
+            "BENCH_r01.json",
+            {
+                "n": 1,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 500.0}},
+            },
+        )
+        _write(
+            tmp_path,
+            "BENCH_r02.json",
+            {
+                "n": 2,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 100.0}},
+            },
+        )
+        runs = load_trajectory(str(tmp_path))
+        lines, regressions = evaluate(runs, 0.10)
+        assert regressions and "event_storm.apply_sps" in regressions[0]
+        assert any("REGRESSED" in line for line in lines)
