@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke perf-trend profile-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke offload-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke perf-trend profile-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke offload-smoke replay-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -71,6 +71,18 @@ obs-smoke:
 # asserted via envelope_violations (docs/observability.md).
 slo-smoke:
 	$(CPU_ENV) $(PYTHON) hack/slo_smoke.py
+
+# Incident capture & replay smoke (same invocation as CI's "Replay
+# smoke" step): booted service under event + scoring traffic with the
+# input flight recorder attached — a forced SLO violation writes one
+# incident bundle (capture + traces + profile + timeline + slo +
+# config fingerprint, listed at /debug/incidents), replaying the
+# bundle's capture through a fresh stack reproduces every recorded
+# score bit-identically and the final index state exactly, and a
+# deliberately mutated capture reports a first-divergence point
+# (docs/observability.md "Incident response runbook").
+replay-smoke:
+	$(CPU_ENV) $(PYTHON) hack/replay_smoke.py
 
 # Read-path perf smoke (same invocation as CI's "Read-path perf
 # smoke" step): a few seconds of the bench's read_path regime on CPU,

@@ -231,6 +231,11 @@ def emit_result(full: dict, probe: dict) -> None:
             "prof_overhead": (
                 read_path.get("profiler_ab") or {}
             ).get("overhead"),
+            # The capture_ab cells (read_path AND event_storm) stay
+            # detail-only: the compact line sits within ~100 bytes of
+            # the shed budget in full tiny runs, and adding one more
+            # field here shed indexer_restart off the line (the
+            # driver-contract test pins that block's presence).
         }
     cache_analytics = detail.get("cache_analytics") or {}
     cache_analytics_compact = None
@@ -2240,6 +2245,51 @@ def bench_read_path(cell_seconds: Optional[float] = None) -> dict:
             "within_bound": overhead <= PROFILE_OVERHEAD_BOUND,
             "top_self": top_self,
         }
+
+        # ---- capture A/B: the always-on input flight recorder's cost
+        # to the warm-multi-turn headline (obs/capture.py; ISSUE 15's
+        # ≤3% acceptance bound).  The recorder's hot-path work is one
+        # lock hop + a tuple append per scored request (token lists
+        # ride by reference, serialization is dump-time only), so the
+        # A/B is measured the same alternating best-of-4 way as the
+        # profiler's — the signal is well under scheduler noise at
+        # shorter settings.
+        from llm_d_kv_cache_manager_tpu.obs.capture import (
+            CaptureConfig,
+            InputCaptureRecorder,
+        )
+
+        # Shipped-default config (same reasoning as the event_storm
+        # cell: the bound is a claim about production settings).
+        recorder = InputCaptureRecorder(CaptureConfig())
+        best = {True: 0.0, False: 0.0}
+        # Best-of-6 (vs the profiler's 4): the recorder's true cost is
+        # ~1% — a single scheduler hiccup on the off side at best-of-4
+        # could still read past the 3% bound.
+        for ab_round in range(6):
+            order = (True, False) if ab_round % 2 == 0 else (False, True)
+            for cap_on in order:
+                fast.set_capture(recorder if cap_on else None)
+                best[cap_on] = max(
+                    best[cap_on],
+                    run_cell(fast, turns)["scores_per_sec"],
+                )
+        fast.set_capture(None)
+        ring = recorder.status()["sources"]["scores"]
+        overhead = (
+            max(0.0, (best[False] - best[True]) / best[False])
+            if best[False]
+            else 0.0
+        )
+        result["capture_ab"] = {
+            "capture_on_sps": best[True],
+            "capture_off_sps": best[False],
+            "overhead": round(overhead, 4),
+            "bound": CAPTURE_OVERHEAD_BOUND,
+            "within_bound": overhead <= CAPTURE_OVERHEAD_BOUND,
+            "recorded": ring["appended"],
+            "ring_bytes": ring["bytes"],
+        }
         return result
     finally:
         fast.shutdown()
@@ -2272,6 +2322,11 @@ TRACE_OVERHEAD_BOUND = 0.03
 # headline at its DEFAULT rate (obs/profiler.py; the read_path and
 # event_storm profiler_ab cells assert it).
 PROFILE_OVERHEAD_BOUND = 0.03
+# Pinned ceiling for the always-on input flight recorder's cost to
+# the same two headlines (obs/capture.py; the read_path and
+# event_storm capture_ab cells assert it — the ISSUE 15 acceptance
+# bound for capture-on overhead).
+CAPTURE_OVERHEAD_BOUND = 0.03
 
 
 def bench_replica_scaleout(
@@ -4200,6 +4255,9 @@ def bench_event_storm(
 
         # -- profiler A/B on the apply path ---------------------------
         result["profiler_ab"] = _storm_profiler_ab(fleet.payload)
+
+        # -- capture A/B on the apply path ----------------------------
+        result["capture_ab"] = _storm_capture_ab(fleet.payload)
         return result
     finally:
         fleet.close()
@@ -4271,6 +4329,85 @@ def _storm_profiler_ab(payload: bytes, rounds: int = 2) -> dict:
         "overhead": round(overhead, 4),
         "bound": PROFILE_OVERHEAD_BOUND,
         "within_bound": overhead <= PROFILE_OVERHEAD_BOUND,
+    }
+
+
+def _storm_capture_ab(payload: bytes, rounds: int = 5) -> dict:
+    """Input-flight-recorder on-vs-off A/B on the decode+apply hot
+    path (obs/capture.py; ISSUE 15's ≤3% acceptance bound) — the same
+    in-process batched-sink shape as ``_storm_profiler_ab``, with the
+    capture tap (payload stash + compact ring append per message in
+    ``Pool.add_tasks``) attached on one side.  Longer runs and more
+    best-of rounds than the profiler cell: the tap's true cost
+    (~0.5µs/msg against a ~25µs/msg all-in-process apply) sits near
+    this container class's run-to-run noise floor."""
+    from llm_d_kv_cache_manager_tpu.obs.capture import (
+        CaptureConfig,
+        InputCaptureRecorder,
+    )
+
+    n_msgs = 8000
+    n_pods = 16
+
+    def one_burst(pool) -> float:
+        messages = [
+            Message(
+                topic=f"kv@cab-{i % n_pods}@{MODEL_NAME}",
+                payload=payload,
+                pod_identifier=f"cab-{i % n_pods}",
+                model_name=MODEL_NAME,
+                seq=i // n_pods + 1,
+            )
+            for i in range(n_msgs)
+        ]
+        t0 = time.perf_counter()
+        for start in range(0, n_msgs, 64):
+            pool.add_tasks(messages[start:start + 64])
+        pool.drain()
+        elapsed = time.perf_counter() - t0
+        return round(n_msgs / elapsed, 1) if elapsed else 0.0
+
+    # Shipped-default config: the bound is a claim about production
+    # settings, and an oversized ring just measures gc scans of its
+    # own retained objects instead of the tap.
+    recorder = InputCaptureRecorder(CaptureConfig())
+    # One WARM pool per side, reused across rounds: per-run pool
+    # construction (worker-thread startup, cold shard caches) costs
+    # more run-to-run variance than the tap itself.
+    pool_off, _index_off, _db_off = _storm_pool(concurrency=4)
+    pool_on, _index_on, _db_on = _storm_pool(concurrency=4)
+    pool_on.set_capture(recorder)
+    best = {True: 0.0, False: 0.0}
+    try:
+        one_burst(pool_off)  # warmup both sides
+        one_burst(pool_on)
+        for ab_round in range(rounds):
+            order = (
+                (True, False) if ab_round % 2 == 0 else (False, True)
+            )
+            for cap_on in order:
+                best[cap_on] = max(
+                    best[cap_on],
+                    one_burst(pool_on if cap_on else pool_off),
+                )
+    finally:
+        pool_off.shutdown()
+        pool_on.shutdown()
+    ring = recorder.status()["sources"]["kvevents"]
+    overhead = (
+        max(0.0, (best[False] - best[True]) / best[False])
+        if best[False]
+        else 0.0
+    )
+    return {
+        "n_msgs": n_msgs,
+        "capture_on_msgs_per_sec": best[True],
+        "capture_off_msgs_per_sec": best[False],
+        "overhead": round(overhead, 4),
+        "bound": CAPTURE_OVERHEAD_BOUND,
+        "within_bound": overhead <= CAPTURE_OVERHEAD_BOUND,
+        "recorded": ring["appended"],
+        "ring_bytes": ring["bytes"],
     }
 
 

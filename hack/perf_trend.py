@@ -16,7 +16,14 @@ the previous bench pinned and no gate would notice.  This script:
   headline);
 * exits non-zero when the NEWEST artifact regresses any
   higher-is-better headline by more than ``--threshold`` (default
-  10%) against that prior value.
+  10%) against that prior value;
+* also folds the ``MULTICHIP_r<NN>.json`` trajectory (the driver's
+  virtual-multichip dryrun artifacts, including the PR-11 staged
+  offload-lanes cell) into a DISPLAY-ONLY table — pass/fail status,
+  device count, and any numeric throughput fields the dryrun grows —
+  so the offload-lanes trajectory is visible in ``make perf-trend``
+  without gating on it (the dryrun is a compile check, not a perf
+  measurement).
 
 Regimes rotate between runs, so a headline absent from the newest
 artifact is simply not compared — only measured regressions fail.
@@ -39,6 +46,7 @@ from typing import Dict, List, Optional, Tuple
 DEFAULT_THRESHOLD = 0.10
 
 _ARTIFACT_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
 
 # Headline keys gated by the regression check.  All are
 # higher-is-better by construction (throughputs, speedups,
@@ -193,6 +201,96 @@ def load_trajectory(
     return runs
 
 
+def extract_multichip(artifact: dict) -> Dict[str, object]:
+    """Display-only facts from one MULTICHIP artifact: run status,
+    device count, the staged-offload dry-run marker, and any numeric
+    throughput fields a future dryrun grows (``*_mb_s`` / ``*_sps`` /
+    ``*_gbps`` at any merged container level)."""
+    rc = artifact.get("rc", 0)
+    if artifact.get("skipped"):
+        status = "skipped"
+    elif rc not in (0, None):
+        status = f"FAIL(rc={rc})"
+    else:
+        status = "ok"
+    out: Dict[str, object] = {"status": status}
+    devices = _num(artifact.get("n_devices"))
+    if devices is not None:
+        out["n_devices"] = int(devices)
+    merged = _merged_containers(artifact)
+    # Numeric throughput fields at the merged top level OR one regime
+    # block down (e.g. a future host_offload lanes cell).
+    containers = [merged] + [
+        value for value in merged.values() if isinstance(value, dict)
+    ]
+    for container in containers:
+        for key in sorted(container):
+            if isinstance(key, str) and key.endswith(
+                ("_mb_s", "_sps", "_gbps")
+            ):
+                value = _num(container[key])
+                if value is not None:
+                    out.setdefault(key, value)
+    tail = artifact.get("tail") or ""
+    if "staged offload dry run ok" in str(tail):
+        out["staged_offload"] = "ok"
+    return out
+
+
+def load_multichip_trajectory(
+    directory: str,
+) -> List[Tuple[int, str, Dict[str, object]]]:
+    """[(run number, filename, facts)] sorted oldest first."""
+    runs: List[Tuple[int, str, Dict[str, object]]] = []
+    for path in glob.glob(os.path.join(directory, "MULTICHIP_r*.json")):
+        match = _MULTICHIP_RE.search(os.path.basename(path))
+        if not match:
+            continue
+        try:
+            with open(path) as handle:
+                artifact = json.load(handle)
+        except (OSError, ValueError) as exc:
+            print(f"perf-trend: skipping unreadable {path}: {exc}")
+            continue
+        if not isinstance(artifact, dict):
+            print(f"perf-trend: skipping non-object {path}")
+            continue
+        runs.append(
+            (
+                int(match.group(1)),
+                os.path.basename(path),
+                extract_multichip(artifact),
+            )
+        )
+    runs.sort(key=lambda item: item[0])
+    return runs
+
+
+def multichip_lines(
+    runs: List[Tuple[int, str, Dict[str, object]]],
+) -> List[str]:
+    """Display-only table for the MULTICHIP trajectory (never gated:
+    the dryrun is a compile check whose absolute numbers, when they
+    appear, depend on the host)."""
+    if not runs:
+        return []
+    lines = [
+        f"perf-trend: multichip trajectory ({len(runs)} artifacts, "
+        "display-only, never gated)"
+    ]
+    for n, _name, facts in runs:
+        parts = [str(facts.get("status", "?"))]
+        for key, value in facts.items():
+            if key == "status":
+                continue
+            if isinstance(value, float):
+                parts.append(f"{key}={value:.3f}")
+            else:
+                parts.append(f"{key}={value}")
+        lines.append(f"  r{n:02d}  " + "  ".join(parts))
+    return lines
+
+
 def evaluate(
     runs: List[Tuple[int, str, Dict[str, float]]],
     threshold: float,
@@ -266,6 +364,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     runs = load_trajectory(args.dir)
     lines, regressions = evaluate(runs, args.threshold)
     for line in lines:
+        print(line)
+    for line in multichip_lines(load_multichip_trajectory(args.dir)):
         print(line)
     if regressions:
         print(

@@ -59,6 +59,8 @@ def _make_handler(
     slo=None,
     profiler=None,
     timeline=None,
+    capture=None,
+    incidents=None,
 ):
     class Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -260,6 +262,24 @@ def _make_handler(
                     except Exception:  # noqa: BLE001 — health must answer
                         logger.exception("slo status failed")
                         health["slo"] = {"error": "unavailable"}
+                # Config fingerprint + capture/incident liveness: the
+                # same fingerprint stamped into capture headers and
+                # incident bundles, so "would a replay of that bundle
+                # match THIS process" is one healthz read away
+                # (docs/observability.md "Incident capture & replay").
+                try:
+                    from llm_d_kv_cache_manager_tpu.obs.capture import (
+                        fingerprint_status,
+                    )
+
+                    health["fingerprint"] = fingerprint_status()
+                    if capture is not None:
+                        health["capture"] = capture.status()
+                    if incidents is not None:
+                        health["incidents"] = incidents.status()
+                except Exception:  # noqa: BLE001 — health must answer
+                    logger.exception("capture status failed")
+                    health["capture"] = {"error": "unavailable"}
                 self._reply_json(200, health)
             elif path in ("/debug", "/debug/"):
                 self._debug_index()
@@ -279,6 +299,8 @@ def _make_handler(
                 self._debug_profile(query)
             elif path == "/debug/timeline":
                 self._debug_timeline(query)
+            elif path == "/debug/incidents":
+                self._debug_incidents()
             else:
                 self._error(404, "not found")
 
@@ -357,6 +379,31 @@ def _make_handler(
                         "(?last=<seconds>, ?series=<name>)"
                     ),
                 },
+                {
+                    "path": "/debug/incidents",
+                    "enabled": incidents is not None,
+                    "description": (
+                        "incident capture plane: input flight-recorder "
+                        "occupancy + SLO-triggered replayable bundles "
+                        "(POST /admin/incident forces one)"
+                    ),
+                    "status": (
+                        {
+                            "capture": (
+                                capture.status()["sources"]
+                                if capture is not None
+                                else None
+                            ),
+                            "last_incident": (
+                                incidents.status()["last_incident"]
+                                if incidents is not None
+                                else None
+                            ),
+                        }
+                        if capture is not None or incidents is not None
+                        else None
+                    ),
+                },
             ]
             self._reply_json(
                 200,
@@ -433,6 +480,28 @@ def _make_handler(
                     last_s=last_s, series=query.get("series")
                 ),
             )
+
+        def _debug_incidents(self):
+            """Read-only incident capture plane: flight-recorder ring
+            occupancy (bytes, records, truncation) and every retained
+            incident bundle's manifest, newest first
+            (docs/observability.md "Incident response runbook")."""
+            if capture is None and incidents is None:
+                self._error(404, "capture disabled (CAPTURE=0)")
+                return
+            try:
+                payload = {
+                    "capture": (
+                        capture.status() if capture is not None else None
+                    ),
+                }
+                if incidents is not None:
+                    payload.update(incidents.status())
+                    payload["incidents"] = incidents.list()
+                self._reply_json(200, payload)
+            except Exception as exc:  # noqa: BLE001 — debug must answer
+                logger.exception("incident status failed")
+                self._error(500, f"error: {exc}")
 
         def _debug_slo(self):
             """Read-only degradation envelopes: per-SLI state, burn
@@ -589,6 +658,8 @@ def _make_handler(
                     self._purge_pod()
                 elif path == "/admin/snapshot":
                     self._snapshot()
+                elif path == "/admin/incident":
+                    self._incident()
                 elif path == "/replica":
                     self._replica_call()
                 else:
@@ -707,6 +778,38 @@ def _make_handler(
                     "engine_mappings": info.engine_mappings,
                 },
             )
+
+        def _incident(self):
+            """Operator trigger: bundle the capture window + debug
+            surfaces NOW (docs/observability.md "Incident response
+            runbook") — e.g. to pin a live anomaly the SLO engine has
+            not (yet) classified as violated.  Admin-gated like
+            purge_pod; bypasses the SLO trigger's rate limit.  Body is
+            optional: ``{"reason": "..."}``."""
+            if not self._admin_allowed():
+                self._error(403, "admin endpoint: token or loopback only")
+                return
+            reason = "admin"
+            if self._declares_body():
+                request = self._read_json()
+                if request is None:
+                    return
+                reason = str(request.get("reason") or "admin")
+            if incidents is None:
+                self._error(503, "incident capture not configured")
+                return
+            try:
+                manifest = incidents.trigger(
+                    f"admin:{reason}", force=True
+                )
+            except Exception as exc:  # noqa: BLE001 — reply, don't wedge
+                logger.exception("admin incident trigger failed")
+                self._error(500, f"error: {exc}")
+                return
+            if manifest is None:
+                self._error(500, "incident bundle failed (see logs)")
+                return
+            self._reply_json(200, manifest)
 
         @staticmethod
         def _wants_explain(query) -> bool:
@@ -884,6 +987,8 @@ def serve(
     slo=None,
     profiler=None,
     timeline=None,
+    capture=None,
+    incidents=None,
 ) -> http.server.ThreadingHTTPServer:
     """Start the HTTP service on a background thread; returns the server
     (call ``.shutdown()`` to stop).  ``admin_token`` (env:
@@ -904,9 +1009,11 @@ def serve(
     ``slo`` (an ``obs.slo.SloEngine``) backs ``GET /debug/slo`` and
     the ``/healthz`` degradation-envelope block; ``profiler`` (an
     ``obs.SamplingProfiler``) backs ``GET /debug/profile`` and
-    ``timeline`` (an ``obs.GaugeTimeline``) ``GET /debug/timeline``
-    — ``GET /debug/`` indexes every surface
-    (docs/observability.md)."""
+    ``timeline`` (an ``obs.GaugeTimeline``) ``GET /debug/timeline``;
+    ``capture`` (an ``obs.InputCaptureRecorder``) and ``incidents``
+    (an ``obs.IncidentManager``) back ``GET /debug/incidents``,
+    ``POST /admin/incident`` and the ``/healthz`` capture block —
+    ``GET /debug/`` indexes every surface (docs/observability.md)."""
     server = _NamedThreadingHTTPServer(
         (host, port),
         _make_handler(
@@ -922,6 +1029,8 @@ def serve(
             slo=slo,
             profiler=profiler,
             timeline=timeline,
+            capture=capture,
+            incidents=incidents,
         ),
     )
     thread = threading.Thread(
@@ -1043,7 +1152,34 @@ def main() -> None:  # pragma: no cover - CLI entry
 
             injected_index = InstrumentedIndex(injected_index)
 
-    indexer = Indexer(config, kv_block_index=injected_index)
+    # CAPTURE (default on) wires the input flight recorder
+    # (obs/capture.py): the kvevents pool and the indexer tap every
+    # ingress message/scored request into bounded rings
+    # (CAPTURE_WINDOW_S / CAPTURE_MAX_BYTES) that incident bundles
+    # snapshot and obs/replay.py re-drives.  CAPTURE=0 is fully inert:
+    # no recorder object, no ring, no thread — the taps see None.
+    from llm_d_kv_cache_manager_tpu.obs.capture import (
+        CaptureConfig,
+        InputCaptureRecorder,
+        capture_enabled_env,
+        set_build_info_metric,
+    )
+
+    set_build_info_metric()
+    capture = None
+    if capture_enabled_env():
+        capture = InputCaptureRecorder(
+            CaptureConfig.from_env(),
+            meta={
+                "block_size": config.token_processor_config.block_size,
+                "hash_seed": config.token_processor_config.hash_seed,
+                "model": os.environ.get("MODEL_NAME", ""),
+            },
+        )
+
+    indexer = Indexer(
+        config, kv_block_index=injected_index, capture_recorder=capture
+    )
     indexer.run()
 
     # CLUSTER_SELF makes this process a cluster REPLICA: the local
@@ -1196,6 +1332,7 @@ def main() -> None:  # pragma: no cover - CLI entry
             not in ("0", "false", "no"),
         ),
         journal=persistence.journal if persistence else None,
+        capture=capture,
     )
     pool.start()
     # Gap-driven anti-entropy (docs/event-plane.md): a wire-level seq
@@ -1379,6 +1516,66 @@ def main() -> None:  # pragma: no cover - CLI entry
         )
         slo_engine.start(float(os.environ.get("SLO_POLL_S", "5")))
 
+    # Incident bundler (obs/capture.py): subscribes to the SLO
+    # engine's overall-state transitions — healthy→violated dumps the
+    # capture window plus every other debug surface into one versioned
+    # bundle under INCIDENT_DIR, rate-limited by
+    # INCIDENT_MIN_INTERVAL_S and pruned to INCIDENT_KEEP;
+    # POST /admin/incident forces one (docs/observability.md).
+    incidents = None
+    if capture is not None:
+        from llm_d_kv_cache_manager_tpu.obs.capture import (
+            IncidentManager,
+        )
+        from llm_d_kv_cache_manager_tpu.utils import lockorder
+
+        incident_sources = {
+            "traces": lambda: {
+                "stats": TRACER.stats(),
+                "slow": [
+                    t.to_dict() for t in TRACER.recorder.slow(20)
+                ],
+                "errored": [
+                    t.to_dict() for t in TRACER.recorder.errored(20)
+                ],
+                "recent": [
+                    t.to_dict(include_spans=False)
+                    for t in TRACER.recorder.recent(50)
+                ],
+            },
+            "profile": lambda: {
+                "profiler": (
+                    PROFILER.status(top=30)
+                    if PROFILER.config.hz > 0
+                    else {"disabled": True}
+                ),
+                "locks": lockorder.contention_stats(),
+            },
+            "timeline": lambda: (
+                timeline.snapshot()
+                if timeline.window_s > 0
+                else {"disabled": True}
+            ),
+        }
+        if cluster_status is not None:
+            incident_sources["cluster"] = cluster_status
+        if slo_engine is not None:
+            incident_sources["slo"] = (
+                lambda: slo_engine.last_payload() or {"no_data": True}
+            )
+        incidents = IncidentManager(
+            os.environ.get("INCIDENT_DIR", "incidents"),
+            capture=capture,
+            sources=incident_sources,
+            index=indexer.kv_block_index,
+            keep=int(os.environ.get("INCIDENT_KEEP", "8")),
+            min_interval_s=float(
+                os.environ.get("INCIDENT_MIN_INTERVAL_S", "60")
+            ),
+        )
+        if slo_engine is not None:
+            slo_engine.add_listener(incidents.slo_listener())
+
     def event_plane_status() -> dict:
         status = {
             "pollers": manager.poller_count(),
@@ -1404,6 +1601,8 @@ def main() -> None:  # pragma: no cover - CLI entry
         slo=slo_engine,
         profiler=PROFILER,
         timeline=timeline,
+        capture=capture,
+        incidents=incidents,
     )
     try:
         threading.Event().wait()

@@ -252,6 +252,7 @@ class Indexer:
         cache_stats_ledger=None,
         policy_engine=None,
         kv_block_index: Optional[Index] = None,
+        capture_recorder=None,
     ) -> None:
         self.config = config or IndexerConfig()
         self.token_processor = token_processor or ChunkedTokenDatabase(
@@ -374,6 +375,14 @@ class Indexer:
                 self.cache_stats = CacheStatsLedger()
                 self._owns_ledger = True
 
+        # Input flight recorder (obs/capture.py): every scored request
+        # lands in the capture ring — model, SERVED token chain, pod
+        # filter, returned scores — after scoring, outside index
+        # locks, so an incident bundle can replay the read path to a
+        # divergence (obs/replay.py).  None (the default and the
+        # CAPTURE=0 path) costs one ``is None`` check per request.
+        self.capture = capture_recorder
+
         # Predictive-tiering hook (tiering/engine.py): sampled scoring
         # requests feed the engine's PolicyFeed, and explain carries
         # compute-or-load advice.  Attached, never constructed here.
@@ -413,6 +422,29 @@ class Indexer:
 
     def set_tokenizer(self, tokenizer: Tokenizer, model_name: str) -> None:
         self.tokenization_pool.set_tokenizer(tokenizer, model_name)
+
+    def set_capture(self, capture_recorder) -> None:
+        """Attach/detach the input flight recorder after construction
+        (obs/capture.py).  Racy-benign: scoring threads read the
+        attribute once per request."""
+        self.capture = capture_recorder
+
+    def _capture_score(
+        self,
+        model_name: str,
+        tokens: Sequence[int],
+        pod_identifiers: Optional[Sequence[str]],
+        scores: Dict[str, float],
+    ) -> None:
+        """Capture must never fail a scoring request (same contract
+        as the analytics ledger).  Scores are copied — the caller owns
+        the returned dict and may mutate it."""
+        try:
+            self.capture.record_score(
+                model_name, tokens, pod_identifiers, dict(scores)
+            )
+        except Exception:  # noqa: BLE001 - scoring outlives capture bugs
+            logger.exception("input capture record failed")
 
     def set_policy_engine(self, policy_engine) -> None:
         """Attach a tiering PolicyEngine after construction (binds the
@@ -492,10 +524,12 @@ class Indexer:
         wraps, unrolled here so the chain's attribution state is
         readable).  Kept as the parity oracle (READ_PATH_FAST_LANE=0)
         and the fallback when the fast lane is configured off."""
-        _, block_keys = self._tokens_and_block_keys(
+        tokens, block_keys = self._tokens_and_block_keys(
             prompt, model_name, render_req
         )
         if not block_keys:
+            if self.capture is not None:
+                self._capture_score(model_name, tokens, pod_identifiers, {})
             return {}
 
         ledger = self.cache_stats
@@ -531,6 +565,8 @@ class Indexer:
             )
             if self.policy_engine is not None:
                 self.policy_engine.observe_scored(block_keys, family)
+        if self.capture is not None:
+            self._capture_score(model_name, tokens, pod_identifiers, scores)
         logger.debug(
             "scored %d pods over %d block keys", len(scores), len(block_keys)
         )
@@ -569,6 +605,8 @@ class Indexer:
         block_size = self.token_processor.block_size
         total_blocks = len(tokens) // block_size
         if total_blocks == 0:
+            if self.capture is not None:
+                self._capture_score(model_name, tokens, pod_identifiers, {})
             return {}
 
         memo_keys = result.memo_keys
@@ -616,6 +654,13 @@ class Indexer:
                         self.policy_engine.observe_scored(
                             hit.touch_keys, hit.family
                         )
+                if self.capture is not None:
+                    # The memo's tokens ARE the served stream (the
+                    # validator just proved it) — no copy needed.
+                    self._capture_score(
+                        model_name, hit.tokens, pod_identifiers,
+                        hit.scores,
+                    )
                 logger.debug(
                     "score-memo hit: %d pods over %d chain keys",
                     len(hit.scores),
@@ -812,6 +857,10 @@ class Indexer:
             span = tracer.add_completed("score", end - score_s, end)
             span.set_attr("pods", len(chain.scores))
             span.set_attr("provenance", _provenance_attr(chain))
+        if self.capture is not None:
+            self._capture_score(
+                model_name, tokens, pod_identifiers, chain.scores
+            )
         logger.debug(
             "fast-lane scored %d pods over %d/%d block keys "
             "(%d memoized)",
@@ -849,6 +898,8 @@ class Indexer:
             "pods": {},
         }
         if not block_keys:
+            if self.capture is not None:
+                self._capture_score(model_name, tokens, pod_identifiers, {})
             return {}, explanation
 
         pod_set = set(pod_identifiers) if pod_identifiers else None
@@ -870,6 +921,11 @@ class Indexer:
             )
         explanation["pods"] = per_pod
         scores = {pod: detail["score"] for pod, detail in per_pod.items()}
+        if self.capture is not None:
+            # Explain requests are scoring requests too: the replay
+            # harness re-drives them through the plain scoring path
+            # (scores are identical by the explain≡score property).
+            self._capture_score(model_name, tokens, pod_identifiers, scores)
         ledger = self.cache_stats
         if ledger is not None and ledger.should_sample():
             # Explain requests are scoring requests too.  Attribution

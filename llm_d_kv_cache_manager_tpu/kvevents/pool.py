@@ -232,6 +232,13 @@ class Message:
     # decode when set.  ``_DECODE_FAILED`` marks a poison pill already
     # counted/logged at pre-decode time.
     decoded: Optional[object] = None
+    # Payload reference stashed by the input-capture tap BEFORE the
+    # pre-decode stage clears ``payload`` (obs/capture.py): the
+    # capture ring holds the message, and this field keeps the raw
+    # bytes (possibly a zero-copy memoryview — pinned memory is
+    # bounded by CAPTURE_MAX_BYTES) reachable for dump-time
+    # serialization.  Never read by the pool itself.
+    capture_payload: Optional[object] = None
 
 
 @dataclass
@@ -692,6 +699,7 @@ class Pool:
         token_processor: TokenProcessor,
         config: Optional[PoolConfig] = None,
         journal=None,
+        capture=None,
     ) -> None:
         self.config = config or PoolConfig()
         if self.config.concurrency <= 0:
@@ -704,6 +712,15 @@ class Pool:
         # apply is never journaled.  Per-pod order in the journal
         # matches apply order structurally (one pod -> one shard).
         self._journal = journal
+        # Optional input flight recorder (obs/capture.py), tapped in
+        # add_tasks POST shed decision: every ingress message lands in
+        # the capture ring with its admitted/shed disposition so an
+        # incident bundle can be replayed to a divergence
+        # (obs/replay.py).  Resync commands are synthesized repairs,
+        # not ingress, and are never recorded.  None (the default and
+        # the CAPTURE=0 path) leaves the hot path with a single
+        # ``is None`` check.
+        self._capture = capture
         if self.config.max_queue_depth <= 0:
             raise ValueError("pool max_queue_depth must be positive")
         self._queues: List[_ShardQueue] = [
@@ -738,6 +755,13 @@ class Pool:
             "apply_s": 0.0,
             "apply_msgs": 0,
         }
+
+    def set_capture(self, capture) -> None:
+        """Attach/detach the input flight recorder (obs/capture.py)
+        after construction — embedders that build the recorder late.
+        Racy-benign: enqueueing threads read the attribute once per
+        batch."""
+        self._capture = capture
 
     def start(self) -> None:
         with self._lock:
@@ -909,10 +933,20 @@ class Pool:
         if not messages:
             return
         per_shard: Dict[int, List[Message]] = {}
+        # Input capture copies payload bytes BEFORE the lock-free
+        # pre-decode stage releases them (zero-copy ZMQ frames must
+        # not be pinned by the ring, and pre-decode clears payload).
+        cap = self._capture
+        captured: Optional[List[Message]] = (
+            [] if cap is not None else None
+        )
         # Trace start BEFORE pre-decode: a poison pill found at decode
         # must still error its sampled trace for the flight recorder.
         for message in messages:
             self._prepare_message(message)
+            if captured is not None and message.resync is None:
+                message.capture_payload = message.payload
+                captured.append(message)
             per_shard.setdefault(
                 self._shard_index(message.pod_identifier), []
             ).append(message)
@@ -927,10 +961,13 @@ class Pool:
                 self._stage_account(
                     "decode", time.perf_counter() - t0, n_decoded
                 )
+        shed_map: Dict[int, Tuple[Message, str]] = {}
         for shard, batch in per_shard.items():
             shed, depths = self._queues[shard].put_batch(batch)
             # Metrics + trace finishing OUTSIDE the shard lock.
             for dropped, reason in shed:
+                if captured is not None:
+                    shed_map[id(dropped)] = (dropped, reason)
                 METRICS.kvevents_dropped.labels(reason=reason).inc()
                 self._shed_counter(dropped.pod_identifier).inc()
                 self._finish_dropped(dropped, reason)
@@ -941,6 +978,59 @@ class Pool:
                 )
             for pod, depth in depths.items():
                 self._backlog_gauge(pod).set(depth)
+        if captured is not None:
+            try:
+                self._capture_batch(cap, captured, shed_map)
+            except Exception:  # noqa: BLE001 — capture never sheds work
+                logger.exception("input capture failed for a batch")
+
+    @staticmethod
+    def _capture_batch(
+        cap,
+        captured: List[Message],
+        shed_map: Dict[int, Tuple[Message, str]],
+    ) -> None:
+        """Record this enqueue burst post shed decision: every message
+        of the burst lands once (admitted, or its shed reason); a
+        message from an EARLIER burst displaced by this one gets a
+        payload-free displacement record — replay cancels its admitted
+        record against it (obs/replay.py).  The whole burst rides ONE
+        recorder lock round trip so the tap stays inside the
+        event_storm capture_ab overhead bound; the common no-shed
+        burst takes the allocation-free admitted fast path (the ring
+        holds the Message itself, expanded at dump time)."""
+        if not shed_map:
+            cap.record_admitted_messages(captured)
+            return
+        items = []
+        for message in captured:
+            entry = shed_map.pop(id(message), None)
+            items.append(
+                (
+                    message.pod_identifier,
+                    message.topic,
+                    message.model_name,
+                    message.seq,
+                    message.seq_gap,
+                    bytes(message.capture_payload),
+                    "admitted" if entry is None else entry[1],
+                )
+            )
+        for dropped, reason in shed_map.values():
+            if dropped.resync is not None:
+                continue
+            items.append(
+                (
+                    dropped.pod_identifier,
+                    dropped.topic,
+                    dropped.model_name,
+                    dropped.seq,
+                    dropped.seq_gap,
+                    None,
+                    reason,
+                )
+            )
+        cap.record_kvevents_batch(items)
 
     def enqueue_resync(self, job: ResyncJob, trace_: Optional[Trace] = None):
         """Queue an anti-entropy repair in the pod's shard lane (so it
@@ -1093,6 +1183,19 @@ class Pool:
             self._stage_account(
                 "apply", time.perf_counter() - apply_t0, apply_n
             )
+        # Applied messages may be retained by the input-capture ring
+        # (compact records hold the Message itself); dropping the
+        # decoded-batch and trace refs here keeps that retention at
+        # payload cost, not payload + decoded-object + finished-trace
+        # cost (the flight recorder holds its own trace refs, and
+        # pending_traces below carries the ones still to finish).
+        # The poison sentinel is a process-wide singleton — keep it
+        # (it is the observable that pre-decode already classified
+        # the message).
+        for message in batch:
+            if message.decoded is not _DECODE_FAILED:
+                message.decoded = None
+            message.trace = None
         # The applier already finished the traces owning any discarded
         # adds as errored (whether the failing flush was this final one
         # or a mid-batch eviction barrier); for everyone else the work

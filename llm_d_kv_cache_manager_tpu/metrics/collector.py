@@ -485,6 +485,39 @@ class KVCacheMetrics:
                 0.025, 0.05, 0.1, 0.25, 1.0,
             ),
         )
+        # Incident capture plane (obs/capture.py; docs/observability.md
+        # "Incident capture & replay").
+        self.build_info = Gauge(
+            f"{_NAMESPACE}_build_info",
+            "Always 1; labels carry the package version and the "
+            "config fingerprint (hash of the resolved score-relevant "
+            "env knobs) stamped into every capture header and "
+            "incident bundle — replays refuse mismatched artifacts.",
+            ("version", "fingerprint"),
+            registry=self.registry,
+        )
+        self.capture_ring_bytes = Gauge(
+            f"{_NAMESPACE}_capture_ring_bytes",
+            "Estimated bytes retained by the input flight recorder "
+            "per ingress source (kvevents / scores); bounded by "
+            "CAPTURE_MAX_BYTES.",
+            ("source",),
+            registry=self.registry,
+        )
+        self.capture_records = Counter(
+            f"{_NAMESPACE}_capture_records_total",
+            "Ingress records appended to the input flight recorder "
+            "per source (refreshed in batches off the hot path).",
+            ("source",),
+            registry=self.registry,
+        )
+        self.incident_bundles = Counter(
+            f"{_NAMESPACE}_incident_bundles_total",
+            "Incident bundles written by outcome (ok / failed); "
+            "SLO-triggered and /admin/incident both count.",
+            ("outcome",),
+            registry=self.registry,
+        )
         # Per-stage latencies fed by the tracing subsystem (obs/trace.py):
         # every span of a sampled trace lands here under its span name, so
         # the aggregate view and the per-request flight-recorder view
@@ -666,10 +699,15 @@ def start_metrics_logging(interval_seconds: float = 60.0) -> threading.Event:
             # process block (rss/fds/threads/gc) is the leak telltale:
             # those climb for minutes before anything else degrades.
             proc = update_process_metrics()
+            # capture_kb / incidents join the line for the same reason
+            # dropped_events did: during an incident the flight
+            # recorder's occupancy says whether the replay window is
+            # still intact, and a climbing incident count says the SLO
+            # engine is actively bundling (docs/observability.md).
             logger.info(
                 "metrics beat: admissions=%d evictions=%d lookups=%d "
                 "hits=%d dropped_events=%d journal_lag=%d rss_mb=%.1f "
-                "fds=%d threads=%d gc=%d",
+                "fds=%d threads=%d gc=%d capture_kb=%.0f incidents=%d",
                 counter_total(METRICS.index_admissions),
                 counter_total(METRICS.index_evictions),
                 counter_total(METRICS.index_lookup_requests),
@@ -680,6 +718,8 @@ def start_metrics_logging(interval_seconds: float = 60.0) -> threading.Event:
                 proc["open_fds"],
                 proc["threads"],
                 counter_total(METRICS.gc_collections),
+                gauge_total(METRICS.capture_ring_bytes) / 1e3,
+                counter_total(METRICS.incident_bundles),
             )
 
     thread = threading.Thread(target=beat, name="kvtpu-metrics-beat", daemon=True)

@@ -7,6 +7,21 @@ See docs/observability.md.  Import surface:
     )
 """
 
+from llm_d_kv_cache_manager_tpu.obs.capture import (
+    CaptureConfig,
+    IncidentManager,
+    InputCaptureRecorder,
+    capture_enabled_env,
+    config_fingerprint,
+    fingerprint_status,
+    set_build_info_metric,
+)
+from llm_d_kv_cache_manager_tpu.obs.replay import (
+    CaptureMismatchError,
+    ReplayReport,
+    load_capture,
+    replay_capture,
+)
 from llm_d_kv_cache_manager_tpu.obs.profiler import (
     PROFILER,
     ProfilerConfig,
@@ -39,6 +54,17 @@ from llm_d_kv_cache_manager_tpu.obs.trace import (
 )
 
 __all__ = [
+    "CaptureConfig",
+    "CaptureMismatchError",
+    "IncidentManager",
+    "InputCaptureRecorder",
+    "ReplayReport",
+    "capture_enabled_env",
+    "config_fingerprint",
+    "fingerprint_status",
+    "load_capture",
+    "replay_capture",
+    "set_build_info_metric",
     "FlightRecorder",
     "GaugeTimeline",
     "PROFILER",

@@ -151,6 +151,24 @@ class SloEngine:
         self._series: Dict[str, _Series] = {}  # guarded-by: _lock
         self._evaluations = 0  # guarded-by: _lock
         self._last_payload: Optional[dict] = None  # guarded-by: _lock
+        # Overall-state transition listeners (the incident bundler's
+        # trigger, obs/capture.py): called OUTSIDE the lock with
+        # (old_state, new_state, payload) on every overall-state
+        # change; a raising listener is logged, never propagated.
+        # Transitions are queued under the lock (atomically with the
+        # state update) and drained FIFO by a single dispatcher at a
+        # time, so concurrent evaluate() calls (the poll thread +
+        # /debug/slo hits) can never deliver healthy→violated AFTER
+        # the recovery that followed it — out-of-order delivery would
+        # burn the incident bundler's rate limit on a stale violation.
+        self._listeners: List[
+            Callable[[str, str, dict], None]
+        ] = []  # guarded-by: _lock
+        self._last_state: Optional[str] = None  # guarded-by: _lock
+        self._transitions: Deque[
+            Tuple[str, str, dict]
+        ] = deque()  # guarded-by: _lock
+        self._dispatching = False  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -175,6 +193,17 @@ class SloEngine:
     def sli_names(self) -> List[str]:
         with self._lock:
             return sorted(self._series)
+
+    def add_listener(
+        self, listener: Callable[[str, str, dict], None]
+    ) -> None:
+        """Subscribe to overall-state transitions.  ``listener(old,
+        new, payload)`` runs on whichever thread evaluated (the
+        background poll or a /debug/slo hit), outside the engine lock;
+        the first evaluation compares against ``healthy`` so an engine
+        that boots straight into ``violated`` still notifies."""
+        with self._lock:
+            self._listeners.append(listener)
 
     # -- sampling -------------------------------------------------------
 
@@ -407,7 +436,41 @@ class SloEngine:
         }
         with self._lock:
             self._last_payload = payload
+            previous = self._last_state or STATE_HEALTHY
+            self._last_state = overall
+            if previous != overall and self._listeners:
+                self._transitions.append((previous, overall, payload))
+            if self._transitions and not self._dispatching:
+                self._dispatching = True
+                drain = True
+            else:
+                drain = False
+        if drain:
+            self._drain_transitions()
         return payload
+
+    def _drain_transitions(self) -> None:
+        """Deliver queued state transitions FIFO, one dispatcher at a
+        time (the ``_dispatching`` flag hands late arrivals to the
+        thread already draining); listeners run with NO engine lock
+        held — they may read ``last_payload()`` or trigger an
+        incident bundle."""
+        while True:
+            with self._lock:
+                if not self._transitions:
+                    self._dispatching = False
+                    return
+                previous, overall, payload = self._transitions.popleft()
+                listeners = list(self._listeners)
+            for listener in listeners:
+                try:
+                    listener(previous, overall, payload)
+                except Exception:  # noqa: BLE001 - never down /slo
+                    logger.exception(
+                        "SLO transition listener failed (%s -> %s)",
+                        previous,
+                        overall,
+                    )
 
     # -- surfaces -------------------------------------------------------
 
@@ -423,6 +486,13 @@ class SloEngine:
                 if entry.source_errors
             }
         return payload
+
+    def last_payload(self) -> Optional[dict]:
+        """The most recent full evaluation payload (None before the
+        first) — what the incident bundler snapshots as ``slo.json``
+        without re-sampling every source mid-incident."""
+        with self._lock:
+            return self._last_payload
 
     def healthz_block(self) -> dict:
         """Compact envelope for /healthz, served from the LAST

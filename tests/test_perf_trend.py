@@ -296,3 +296,82 @@ class TestGate:
         lines, regressions = evaluate(runs, 0.10)
         assert regressions and "event_storm.apply_sps" in regressions[0]
         assert any("REGRESSED" in line for line in lines)
+
+
+class TestMultichipDisplay:
+    """ISSUE 15 satellite: MULTICHIP_r*.json folded into the trend
+    table — display-only, never gated."""
+
+    def test_extracts_status_and_devices(self):
+        from hack.perf_trend import extract_multichip
+
+        assert extract_multichip(
+            {"n_devices": 8, "rc": 0, "ok": True, "tail": ""}
+        ) == {"status": "ok", "n_devices": 8}
+        assert extract_multichip({"rc": 1, "tail": "boom"})["status"] == (
+            "FAIL(rc=1)"
+        )
+        assert extract_multichip({"skipped": True})["status"] == "skipped"
+
+    def test_extracts_numeric_throughput_fields(self):
+        from hack.perf_trend import extract_multichip
+
+        facts = extract_multichip(
+            {
+                "n_devices": 4,
+                "rc": 0,
+                "staged_mb_s": 123.4,
+                "host_offload": {"lanes_best_mb_s": 456.0},
+                "tail": "staged offload dry run ok on 4 chips",
+            }
+        )
+        assert facts["staged_mb_s"] == 123.4
+        assert facts["lanes_best_mb_s"] == 456.0
+        assert facts["staged_offload"] == "ok"
+
+    def test_display_lines_and_never_gated(self, tmp_path):
+        from hack.perf_trend import (
+            load_multichip_trajectory,
+            main,
+            multichip_lines,
+        )
+
+        _write(
+            tmp_path,
+            "BENCH_r01.json",
+            {
+                "n": 1,
+                "rc": 0,
+                "compact": {"event_storm": {"apply_sps": 500.0}},
+            },
+        )
+        _write(
+            tmp_path,
+            "MULTICHIP_r01.json",
+            {"n_devices": 8, "rc": 1, "tail": "exploded"},
+        )
+        _write(
+            tmp_path,
+            "MULTICHIP_r02.json",
+            {"n_devices": 8, "rc": 0, "staged_mb_s": 99.5, "tail": ""},
+        )
+        runs = load_multichip_trajectory(str(tmp_path))
+        assert [n for n, _, _ in runs] == [1, 2]
+        lines = multichip_lines(runs)
+        assert any("FAIL(rc=1)" in line for line in lines)
+        assert any("staged_mb_s=99.500" in line for line in lines)
+        # A failing MULTICHIP artifact never fails the gate.
+        assert main(["--dir", str(tmp_path)]) == 0
+
+    def test_real_trajectory_parses(self):
+        from hack.perf_trend import load_multichip_trajectory
+
+        runs = load_multichip_trajectory(REPO_ROOT)
+        assert len(runs) >= 5
+        assert all("status" in facts for _, _, facts in runs)
+
+    def test_unreadable_multichip_skipped(self, tmp_path):
+        from hack.perf_trend import load_multichip_trajectory
+
+        (tmp_path / "MULTICHIP_r01.json").write_text("{nope")
+        assert load_multichip_trajectory(str(tmp_path)) == []

@@ -862,3 +862,247 @@ class TestReplicaIngestor:
         # A re-announce of a foreign pod must not subscribe it.
         assert ingestor.ensure_subscriber(other[0], "tcp://x:2") is False
         assert other[0] not in manager.active
+
+
+class TestCaptureParity:
+    """ISSUE 15 satellite: adversarial write paths — lock-free decode,
+    the coalesced publisher, per-pod shedding, and resync jobs — must
+    record the same input-capture disposition stream as the straight
+    path (obs/capture.py records post shed decision; the stream is
+    what obs/replay.py re-drives)."""
+
+    @staticmethod
+    def _recorder():
+        from llm_d_kv_cache_manager_tpu.obs.capture import (
+            CaptureConfig,
+            InputCaptureRecorder,
+        )
+
+        return InputCaptureRecorder(
+            CaptureConfig(window_s=3600.0, max_bytes=64 << 20),
+            meta={"block_size": BLOCK, "hash_seed": ""},
+        )
+
+    @staticmethod
+    def _per_pod_stream(recorder):
+        """pod -> [(topic, seq, seq_gap, payload, disposition), ...]
+        in capture (global seq) order."""
+        from llm_d_kv_cache_manager_tpu.obs.capture import (
+            load_artifact,
+        )
+
+        out = {}
+        for record in load_artifact(recorder.dump_bytes())["records"]:
+            if record[0] != 0:
+                continue
+            out.setdefault(record[3], []).append(
+                (record[4], record[6], record[7], record[8], record[9])
+            )
+        return out
+
+    @pytest.mark.parametrize("seed", [3, 19])
+    def test_lockfree_equals_straight_disposition_stream(self, seed):
+        """Same seeded per-pod streams (stores, removals, poison
+        pills) through the lock-free pre-decode path and the straight
+        in-worker path: identical per-pod capture subsequences —
+        topic, seq, payload bytes, disposition."""
+        rng = random.Random(seed)
+        pods = [f"cap-{i}" for i in range(8)]
+        streams = {
+            pod: pod_stream(rng, pod, 30, token_offset=30000 * i)
+            for i, pod in enumerate(pods)
+        }
+        captured = {}
+        for lockfree in (True, False):
+            recorder = self._recorder()
+            pool, _index = make_pool(
+                concurrency=2, lockfree_decode=lockfree
+            )
+            pool.set_capture(recorder)
+            run_storm(pool, None, streams, threads=4)
+            captured[lockfree] = self._per_pod_stream(recorder)
+        assert captured[True] == captured[False]
+        # Poison pills are admitted ingress on both sides.
+        total = sum(len(v) for v in captured[True].values())
+        assert total == sum(len(s) for s in streams.values())
+
+    @pytest.mark.parametrize("lockfree", [True, False])
+    def test_shed_dispositions_deterministic_across_lanes(
+        self, lockfree
+    ):
+        """Per-pod shedding against a standing backlog (unstarted
+        pool, deterministic): both decode lanes record the same
+        admitted/pod_budget/queue_full stream, and displaced
+        earlier-admits land as payload-free second records."""
+        recorder = self._recorder()
+        pool, _index = make_pool(
+            concurrency=1,
+            max_queue_depth=6,
+            pod_budget=2,
+            lockfree_decode=lockfree,
+        )
+        pool.set_capture(recorder)
+
+        def msg(pod, seq):
+            return Message(
+                topic=f"kv@{pod}@{MODEL}",
+                payload=b"x",
+                pod_identifier=pod,
+                model_name=MODEL,
+                seq=seq,
+            )
+
+        pool.add_tasks([msg("a", i + 1) for i in range(3)])
+        pool.add_tasks([msg("b", i + 1) for i in range(3)])
+        pool.add_tasks([msg("c", i + 1) for i in range(4)])
+        stream = self._per_pod_stream(recorder)
+        dispositions = {
+            pod: [entry[4] for entry in entries]
+            for pod, entries in stream.items()
+        }
+        # Deterministic regardless of the decode lane: pod a sheds
+        # its own oldest at budget 2 (the victim's record carries the
+        # shed reason at its own stream position — same-batch
+        # displacement), and the stream matches the straight lane's.
+        assert dispositions["a"].count("pod_budget") == 1
+        assert dispositions["a"].count("admitted") == 2
+        assert dispositions == self._expected_dispositions()
+        displaced = [
+            entry
+            for entries in stream.values()
+            for entry in entries
+            if entry[4] != "admitted" and entry[3] is None
+        ]
+        assert displaced, "cross-batch displacement must be recorded"
+
+    _EXPECTED_SHED = None
+
+    @classmethod
+    def _expected_dispositions(cls):
+        """Compute the expected stream ONCE from the straight lane;
+        both parametrized lanes must match it (and each other)."""
+        if cls._EXPECTED_SHED is None:
+            recorder = cls._recorder()
+            pool, _index = make_pool(
+                concurrency=1,
+                max_queue_depth=6,
+                pod_budget=2,
+                lockfree_decode=False,
+            )
+            pool.set_capture(recorder)
+            for pod, n in (("a", 3), ("b", 3), ("c", 4)):
+                pool.add_tasks(
+                    [
+                        Message(
+                            topic=f"kv@{pod}@{MODEL}",
+                            payload=b"x",
+                            pod_identifier=pod,
+                            model_name=MODEL,
+                            seq=i + 1,
+                        )
+                        for i in range(n)
+                    ]
+                )
+            cls._EXPECTED_SHED = {
+                pod: [entry[4] for entry in entries]
+                for pod, entries in cls._per_pod_stream(
+                    recorder
+                ).items()
+            }
+        return cls._EXPECTED_SHED
+
+    def test_resync_jobs_do_not_pollute_the_stream(self):
+        """A mid-stream resync (purge + inventory re-apply) must
+        leave the capture stream of live messages untouched and never
+        appear in it — resync is synthesized repair, not ingress."""
+        rng = random.Random(7)
+        pods = [f"rs-{i}" for i in range(4)]
+        streams = {
+            pod: pod_stream(rng, pod, 20, token_offset=30000 * i)
+            for i, pod in enumerate(pods)
+        }
+
+        def make_resync(pod):
+            def build():
+                done = threading.Event()
+                job = ResyncJob(
+                    pod_identifier=pod,
+                    model_name=MODEL,
+                    events=[],
+                    on_done=lambda *a: done.set(),
+                )
+                return job, done
+
+            return build
+
+        captured = {}
+        for with_resync in (False, True):
+            recorder = self._recorder()
+            pool, _index = make_pool(concurrency=2)
+            pool.set_capture(recorder)
+            run_storm(
+                pool,
+                None,
+                streams,
+                resync_for=(
+                    {pods[0]: make_resync(pods[0])}
+                    if with_resync
+                    else None
+                ),
+                threads=2,
+            )
+            captured[with_resync] = self._per_pod_stream(recorder)
+        assert captured[True] == captured[False]
+        assert all(
+            not topic.startswith("resync@")
+            for entries in captured[True].values()
+            for topic, *_rest in entries
+        )
+
+    def test_coalesced_capture_replays_to_same_state(self):
+        """Coalesced vs uncoalesced publisher wire streams: each
+        capture replays with zero divergence, and the replayed final
+        states are identical — the coalescing parity contract
+        extended through the capture/replay plane (fewer wire
+        records, same truth)."""
+        from llm_d_kv_cache_manager_tpu.obs.capture import (
+            canonical_state,
+        )
+        from llm_d_kv_cache_manager_tpu.obs.replay import (
+            load_capture,
+            replay_capture,
+        )
+
+        publisher = TestPublisherCoalescing()
+        plain_msgs, _t1, _e1 = publisher._publish_stream(
+            coalesce_events=0, events_per_call=1, seed=13
+        )
+        co_msgs, _t2, _e2 = publisher._publish_stream(
+            coalesce_events=8, events_per_call=1, seed=13
+        )
+        pod = plain_msgs[0].pod_identifier
+        for message in co_msgs:
+            message.pod_identifier = pod
+            message.topic = plain_msgs[0].topic
+        states = {}
+        record_counts = {}
+        for name, messages in (
+            ("plain", plain_msgs),
+            ("coalesced", co_msgs),
+        ):
+            recorder = self._recorder()
+            pool, index = make_pool(concurrency=1)
+            pool.set_capture(recorder)
+            pool.start()
+            pool.add_tasks(messages)
+            pool.drain()
+            pool.shutdown()
+            blob = recorder.dump_bytes(index=index)
+            art = load_capture(blob)
+            record_counts[name] = len(art["records"])
+            report = replay_capture(art, mode="single")
+            assert report.ok, (name, report.to_dict())
+            assert report.state_compared, (name, report.to_dict())
+            states[name] = canonical_state(index)
+        assert states["plain"] == states["coalesced"]
+        assert record_counts["coalesced"] < record_counts["plain"]
