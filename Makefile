@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke perf-trend profile-smoke events-smoke cachestats-smoke tiering-smoke cluster-smoke offload-smoke replay-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke perf-trend profile-smoke events-smoke cachestats-smoke tiering-smoke transfer-smoke cluster-smoke offload-smoke replay-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -126,6 +126,16 @@ cachestats-smoke:
 # flips when the RTT estimator is inflated (docs/tiering.md).
 tiering-smoke:
 	$(CPU_ENV) $(PYTHON) hack/tiering_smoke.py
+
+# Transfer smoke (same invocation as CI's "Transfer smoke" step):
+# booted service with a TransferEngine — planned scoring yields a
+# priced pod-to-pod directive, executing it publishes real KVEvents
+# (the target's live score rises 0 -> full chain), and a cold pod
+# registering for instant-warm gets the hot family pre-placed by the
+# warm-up worker, all visible in /debug/transfer, /metrics and
+# /healthz (docs/transfer.md).
+transfer-smoke:
+	$(CPU_ENV) $(PYTHON) hack/transfer_smoke.py
 
 # Host-offload smoke (same invocation as CI's "Host-offload smoke"
 # step): the staging engine moves real bytes — store->evict->load

@@ -54,6 +54,7 @@ def _make_handler(
     event_plane_status=None,
     auditor=None,
     tiering=None,
+    transfer=None,
     replica=None,
     cluster_status=None,
     slo=None,
@@ -254,6 +255,23 @@ def _make_handler(
                     except Exception:  # noqa: BLE001 — health must answer
                         logger.exception("tiering status failed")
                         health["tiering"] = {"error": "unavailable"}
+                if transfer is not None:
+                    # Compact: full engine status lives at
+                    # /debug/transfer; health carries the liveness bits.
+                    try:
+                        status = transfer.status()
+                        health["transfer"] = {
+                            "plans": status["planner"]["plans"],
+                            "outcomes": status["planner"]["outcomes"],
+                            "cold_pods": (
+                                len(status["warmup"]["cold_pods"])
+                                if status["warmup"]
+                                else 0
+                            ),
+                        }
+                    except Exception:  # noqa: BLE001 — health must answer
+                        logger.exception("transfer status failed")
+                        health["transfer"] = {"error": "unavailable"}
                 if slo is not None:
                     # Compact degradation envelope; the full per-SLI
                     # payload lives at /debug/slo.
@@ -291,6 +309,8 @@ def _make_handler(
                 self._debug_cachestats(query)
             elif path == "/debug/tiering":
                 self._debug_tiering()
+            elif path == "/debug/transfer":
+                self._debug_transfer()
             elif path == "/debug/cluster":
                 self._debug_cluster()
             elif path == "/debug/slo":
@@ -332,6 +352,15 @@ def _make_handler(
                     "description": (
                         "predictive tiering: policy feed, advisor, "
                         "eviction and demotion state"
+                    ),
+                },
+                {
+                    "path": "/debug/transfer",
+                    "enabled": transfer is not None,
+                    "description": (
+                        "KV-transfer planning plane: planner "
+                        "outcomes, hot-family catalog, warm-up "
+                        "queue, executor counters"
                     ),
                 },
                 {
@@ -550,6 +579,22 @@ def _make_handler(
                 payload = tiering.status()
             except Exception as exc:  # noqa: BLE001 — debug must answer
                 logger.exception("tiering status failed")
+                self._error(500, f"error: {exc}")
+                return
+            self._reply_json(200, payload)
+
+        def _debug_transfer(self):
+            """Read-only transfer planning plane: planner outcome
+            counters + recent plans, the hot-family catalog, warm-up
+            queue/cold-pod state, and executor counters
+            (docs/transfer.md)."""
+            if transfer is None:
+                self._error(404, "transfer disabled (set TRANSFER=1)")
+                return
+            try:
+                payload = transfer.status()
+            except Exception as exc:  # noqa: BLE001 — debug must answer
+                logger.exception("transfer status failed")
                 self._error(500, f"error: {exc}")
                 return
             self._reply_json(200, payload)
@@ -838,11 +883,13 @@ def _make_handler(
                     entry["errors"] += 1
             return rollup or None
 
-        def _run_scored(self, name, query, score_kwargs):
+        def _run_scored(self, name, query, score_kwargs, plan=False):
             """Shared scoring execution: trace lifecycle (traceparent
             ingest/echo, ``?explain=1`` forcing a sample), the explain
             response shape, and error accounting.  ``score_kwargs`` are
-            handed to ``Indexer.get_pod_scores[_explained]``."""
+            handed to ``Indexer.get_pod_scores[_explained|_planned]``;
+            ``plan`` opts the request into the transfer-directive
+            channel (the planned scoring variant, docs/transfer.md)."""
             explain = self._wants_explain(query)
             req_trace = TRACER.start_trace(
                 name,
@@ -850,12 +897,18 @@ def _make_handler(
                 force=explain,
             )
             started = time.perf_counter()
+            directive = None
             try:
                 with use_trace(req_trace):
                     if explain:
                         scores, detail = (
                             indexer.get_pod_scores_explained(**score_kwargs)
                         )
+                    elif plan:
+                        scores, directive = (
+                            indexer.get_pod_scores_planned(**score_kwargs)
+                        )
+                        detail = None
                     else:
                         scores, detail = (
                             indexer.get_pod_scores(**score_kwargs),
@@ -891,6 +944,16 @@ def _make_handler(
             METRICS.score_latency.observe(elapsed)
             METRICS.score_requests.labels(outcome="ok").inc()
             if not explain:
+                if plan:
+                    # The directive rides the scoring response: the
+                    # scheduler routes to the directive's target with a
+                    # fetch instruction, or falls back to the scores.
+                    self._reply_json(
+                        200,
+                        {"scores": scores, "transfer": directive},
+                        headers,
+                    )
+                    return
                 self._reply_json(200, scores, headers)
                 return
             # explain forces sampling, so req_trace is always live here.
@@ -906,6 +969,27 @@ def _make_handler(
                 200, {"scores": scores, "explain": detail}, headers
             )
 
+        def _parse_pod_loads(self, request):
+            """Optional ``pod_loads`` field: {pod: queue_depth}.
+            Returns (ok, loads_or_None); replies 400 itself on a
+            malformed field."""
+            raw = request.get("pod_loads")
+            if raw is None:
+                return True, None
+            if not isinstance(raw, dict):
+                self._error(400, "field 'pod_loads' must be an object")
+                return False, None
+            loads = {}
+            for pod, depth in raw.items():
+                try:
+                    loads[str(pod)] = float(depth)
+                except (TypeError, ValueError):
+                    self._error(
+                        400, "field 'pod_loads' values must be numbers"
+                    )
+                    return False, None
+            return True, loads
+
         def _score_completions(self, query):
             request = self._read_json()
             if request is None:
@@ -914,6 +998,9 @@ def _make_handler(
             if not prompt:
                 self._error(400, "field 'prompt' required")
                 return
+            ok, pod_loads = self._parse_pod_loads(request)
+            if not ok:
+                return
             self._run_scored(
                 "http.score_completions",
                 query,
@@ -921,7 +1008,9 @@ def _make_handler(
                     prompt=prompt,
                     model_name=request.get("model", ""),
                     pod_identifiers=request.get("pods"),
+                    pod_loads=pod_loads,
                 ),
+                plan=bool(request.get("plan")),
             )
 
         def _score_chat_completions(self, query):
@@ -947,6 +1036,9 @@ def _make_handler(
                 chat_template_kwargs=request.get("chat_template_kwargs"),
                 model=model,
             )
+            ok, pod_loads = self._parse_pod_loads(request)
+            if not ok:
+                return
             self._run_scored(
                 "http.score_chat_completions",
                 query,
@@ -955,7 +1047,9 @@ def _make_handler(
                     model_name=model,
                     pod_identifiers=request.get("pods"),
                     render_req=render_req,
+                    pod_loads=pod_loads,
                 ),
+                plan=bool(request.get("plan")),
             )
 
     return Handler
@@ -982,6 +1076,7 @@ def serve(
     event_plane_status=None,
     auditor=None,
     tiering=None,
+    transfer=None,
     replica=None,
     cluster_status=None,
     slo=None,
@@ -1003,7 +1098,11 @@ def serve(
     ``auditor`` (an ``analytics.IndexAuditor``) adds the index-truth
     audit plane to both; ``tiering`` (a ``tiering.PolicyEngine``)
     backs ``GET /debug/tiering`` and the ``/healthz`` tiering block;
-    ``replica`` (a ``cluster.ClusterReplica``) serves the
+    ``transfer`` (a ``transfer.TransferEngine``) backs
+    ``GET /debug/transfer``, the ``/healthz`` transfer block, and the
+    scoring requests' ``plan``/``pod_loads`` fields
+    (docs/transfer.md); ``replica`` (a ``cluster.ClusterReplica``)
+    serves the
     ``POST /replica`` RPC surface and ``cluster_status`` (a zero-arg
     callable) backs ``GET /debug/cluster`` (docs/replication.md);
     ``slo`` (an ``obs.slo.SloEngine``) backs ``GET /debug/slo`` and
@@ -1024,6 +1123,7 @@ def serve(
             event_plane_status=event_plane_status,
             auditor=auditor,
             tiering=tiering,
+            transfer=transfer,
             replica=replica,
             cluster_status=cluster_status,
             slo=slo,
@@ -1283,6 +1383,26 @@ def main() -> None:  # pragma: no cover - CLI entry
         policy_engine = PolicyEngine(ledger=indexer.cache_stats)
         indexer.set_policy_engine(policy_engine)
 
+    # TRANSFER=1 attaches the KV-transfer planning plane
+    # (docs/transfer.md): scoring requests carrying pod_loads/plan get
+    # transfer directives, executed transfers publish real KVEvents
+    # through the pool (attached below, after the pool exists), and
+    # /debug/transfer exposes the plane.  Shares the tiering advisor
+    # when TIERING=1 so both planes price from one RTT model.
+    transfer_engine = None
+    if os.environ.get("TRANSFER", "").lower() in ("1", "true", "yes"):
+        from llm_d_kv_cache_manager_tpu.transfer import TransferEngine
+
+        transfer_engine = TransferEngine(
+            advisor=(
+                policy_engine.advisor
+                if policy_engine is not None
+                else None
+            ),
+            ledger=indexer.cache_stats,
+        )
+        indexer.set_transfer_engine(transfer_engine)
+
     # PERSISTENCE_DIR enables warm restarts: recover the index from the
     # last snapshot + journal tail BEFORE the event pool starts, then
     # journal every applied event and snapshot periodically.
@@ -1335,6 +1455,16 @@ def main() -> None:  # pragma: no cover - CLI entry
         capture=capture,
     )
     pool.start()
+    if transfer_engine is not None:
+        # The directive channel's write side: executed transfers (and
+        # cold-pod warm-up) publish through this pool, so every move
+        # lands in the index/ledger/journal via the ordinary
+        # decode/apply path.
+        transfer_engine.attach_executor(
+            indexer.kv_block_index,
+            pool,
+            os.environ.get("MODEL_NAME", ""),
+        )
     # Gap-driven anti-entropy (docs/event-plane.md): a wire-level seq
     # gap marks the pod suspect and triggers purge + inventory
     # re-apply.  Without a fleet inventory surface the default "purge"
@@ -1596,6 +1726,7 @@ def main() -> None:  # pragma: no cover - CLI entry
         recovery_report=recovery_report,
         event_plane_status=event_plane_status,
         tiering=policy_engine,
+        transfer=transfer_engine,
         replica=cluster_replica,
         cluster_status=cluster_status,
         slo=slo_engine,
@@ -1637,6 +1768,8 @@ def main() -> None:  # pragma: no cover - CLI entry
             follower.close()
         if cluster_replica is not None:
             cluster_replica.close()
+        if transfer_engine is not None:
+            transfer_engine.close()
         if policy_engine is not None:
             policy_engine.close()
         indexer.shutdown()

@@ -104,6 +104,20 @@ def _env_cache_stats_default() -> bool:
     return raw.strip().lower() not in ("0", "false", "off")
 
 
+def _env_load_blend_default() -> float:
+    """LOAD_BLEND: coefficient folding per-pod queue depth into
+    scores (``score / (1 + blend * depth)``); 0 (the default)
+    disables blending and keeps scores bit-identical to today's."""
+    raw = os.environ.get("LOAD_BLEND", "")
+    if not raw:
+        return 0.0
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        logger.warning("invalid LOAD_BLEND=%r; using 0", raw)
+        return 0.0
+
+
 def _env_score_memo_default() -> Optional[int]:
     """READ_PATH_SCORE_MEMO: "0"/"false"/"off" disables, a positive
     integer sizes the memo, unset defers to the config default."""
@@ -238,6 +252,15 @@ class IndexerConfig:
     # explain surface carries compute-or-load advice.  Config-only
     # construction stays None; the engine is wired by the embedding
     # application (TIERING=1 in the HTTP service).
+    #
+    # Load-blended scoring (docs/transfer.md): when callers pass
+    # per-pod queue depths to get_pod_scores, each score is divided by
+    # ``1 + load_blend * depth`` so the router and the transfer
+    # planner's "holder overloaded" trigger share one signal.  None
+    # resolves from LOAD_BLEND (default 0.0 = off; with no pod_loads
+    # or a zero coefficient the returned dict is the identical object
+    # the unblended path computes).
+    load_blend: Optional[float] = None
 
 
 class Indexer:
@@ -390,6 +413,17 @@ class Indexer:
         if policy_engine is not None:
             self.set_policy_engine(policy_engine)
 
+        # KV-transfer planning hook (transfer/engine.py): the planned
+        # scoring variant and the explain surface carry transfer
+        # directives when an engine is attached (set_transfer_engine;
+        # TRANSFER=1 in the HTTP service).  Attached, never
+        # constructed here — same contract as the policy engine.
+        self.transfer_engine = None
+        load_blend = self.config.load_blend
+        if load_blend is None:
+            load_blend = _env_load_blend_default()
+        self._load_blend = max(0.0, float(load_blend))
+
         if tokenizer is None:
             backends: List[Tokenizer] = []
             if self.config.local_tokenizers_dir:
@@ -466,6 +500,58 @@ class Indexer:
                 "degrades to LRU (docs/tiering.md)"
             )
 
+    def set_transfer_engine(self, transfer_engine) -> None:
+        """Attach a TransferEngine after construction (binds the
+        indexer's ledger for hot-family ranking)."""
+        self.transfer_engine = transfer_engine
+        if transfer_engine is None:
+            return
+        if self.cache_stats is not None:
+            transfer_engine.bind_ledger(self.cache_stats)
+        else:
+            # Same dead-configuration trap as tiering: without the
+            # ledger the warm-up ranking has no reuse signal and falls
+            # back to catalog insertion order.  Be loud once.
+            logger.warning(
+                "TransferEngine attached to an indexer without a "
+                "cachestats ledger (CACHESTATS disabled?): warm-up "
+                "family ranking degrades to catalog order "
+                "(docs/transfer.md)"
+            )
+
+    def _fill_filtered_zero(
+        self,
+        scores: Dict[str, float],
+        pod_identifiers: Optional[Sequence[str]],
+    ) -> Dict[str, float]:
+        """Unknown-pod filter fix-up: pods named in the request filter
+        but absent from the index get an explicit 0.0 entry (not a
+        silently missing key) so planner, ledger, and explain agree on
+        the candidate set.  Mutates and returns ``scores`` (fresh per
+        request in every lane)."""
+        if pod_identifiers:
+            for pod in pod_identifiers:
+                scores.setdefault(pod, 0.0)
+        return scores
+
+    def _blend_loads(
+        self,
+        scores: Dict[str, float],
+        pod_loads: Optional[Dict[str, float]],
+    ) -> Dict[str, float]:
+        """Fold per-pod queue depth into scores: ``score / (1 + blend
+        * depth)``.  With no loads or a zero coefficient the INPUT
+        dict is returned unchanged — planner-off parity stays
+        bit-identical to the unblended path."""
+        blend = self._load_blend
+        if not pod_loads or blend <= 0.0:
+            return scores
+        return {
+            pod: score
+            / (1.0 + blend * max(0.0, float(pod_loads.get(pod, 0.0))))
+            for pod, score in scores.items()
+        }
+
     def _tokens_and_block_keys(
         self,
         prompt: str,
@@ -498,19 +584,26 @@ class Indexer:
         model_name: str,
         pod_identifiers: Optional[Sequence[str]] = None,
         render_req: Optional[ApplyChatTemplateRequest] = None,
+        pod_loads: Optional[Dict[str, float]] = None,
     ) -> Dict[str, float]:
         """Score candidate pods for a prompt.
 
         ``pod_identifiers`` filters the result; None/empty scores every pod
-        the index knows about.
+        the index knows about.  Filtered pods unknown to the index get
+        explicit 0.0 entries.  ``pod_loads`` (optional per-pod queue
+        depths) blends load into the result when the ``LOAD_BLEND``
+        coefficient is set; omitted, scores are bit-identical to the
+        load-blind path.
         """
         if self._fast_lane:
-            return self._get_pod_scores_fast(
+            scores = self._get_pod_scores_fast(
                 prompt, model_name, pod_identifiers, render_req
             )
-        return self._get_pod_scores_straight(
-            prompt, model_name, pod_identifiers, render_req
-        )
+        else:
+            scores = self._get_pod_scores_straight(
+                prompt, model_name, pod_identifiers, render_req
+            )
+        return self._blend_loads(scores, pod_loads)
 
     def _get_pod_scores_straight(
         self,
@@ -549,7 +642,9 @@ class Indexer:
             self.scorer.advance(
                 chain, [key_to_pods.get(key, ()) for key in block_keys]
             )
-            scores = chain.scores
+            scores = self._fill_filtered_zero(
+                chain.scores, pod_identifiers
+            )
             s.set_attr("pods", len(scores))
             if traced:
                 s.set_attr("provenance", _provenance_attr(chain))
@@ -787,6 +882,11 @@ class Indexer:
                 for pod in chain.active:
                     chain.deaths.setdefault(pod, chain.position)
 
+        # Filter fix-up BEFORE the memo store: memo keys include the
+        # pod-filter tuple, so memoized entries carry the filled dict a
+        # re-walk under the same filter would produce.
+        self._fill_filtered_zero(chain.scores, pod_identifiers)
+
         family = None
         if ledger is not None and (sampled or memo_key is not None):
             # The family id must be lane- and memo-state-independent
@@ -871,12 +971,38 @@ class Indexer:
         )
         return chain.scores
 
+    def get_pod_scores_planned(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Optional[Sequence[str]] = None,
+        pod_loads: Optional[Dict[str, float]] = None,
+        render_req: Optional[ApplyChatTemplateRequest] = None,
+    ) -> Tuple[Dict[str, float], Optional[Dict]]:
+        """The opt-in planned scoring variant: ``get_pod_scores`` plus
+        a transfer directive when an attached TransferEngine decides
+        the best holder is overloaded and moving its blocks beats
+        recompute (docs/transfer.md).  Returns ``(scores,
+        directive_or_None)``; rides the explained walk because the
+        planner needs the per-pod provenance, so it shares explain's
+        cost profile — for schedulers that opted in, not the hot path.
+        """
+        scores, explanation = self.get_pod_scores_explained(
+            prompt,
+            model_name,
+            pod_identifiers,
+            render_req,
+            pod_loads=pod_loads,
+        )
+        return scores, explanation.get("transfer")
+
     def get_pod_scores_explained(
         self,
         prompt: str,
         model_name: str,
         pod_identifiers: Optional[Sequence[str]] = None,
         render_req: Optional[ApplyChatTemplateRequest] = None,
+        pod_loads: Optional[Dict[str, float]] = None,
     ) -> Tuple[Dict[str, float], Dict]:
         """``get_pod_scores`` plus a per-pod score explanation.
 
@@ -884,7 +1010,9 @@ class Indexer:
         ``get_pod_scores``.  The explanation carries token/block-key
         counts and, per pod, blocks matched, the block index where the
         consecutive-prefix chain broke, and per-tier hit counts (see
-        ``LongestPrefixScorer.explain``).  The debug surface — slower
+        ``LongestPrefixScorer.explain``); with ``pod_loads`` and an
+        attached TransferEngine it also carries the load blend and the
+        transfer planner's decision.  The debug surface — slower
         than the hot path by the explain bookkeeping (and it always
         walks the full chain: break indices need the straight-line
         path, never the early-exit fast lane); not for every request.
@@ -919,6 +1047,20 @@ class Indexer:
                     for pod, detail in per_pod.items()
                 },
             )
+        if pod_identifiers:
+            # Unknown-pod filter fix-up, explain flavor: explicit
+            # zero-provenance entries so the planner, the ledger, and
+            # this surface agree on the candidate set.
+            for pod in pod_identifiers:
+                per_pod.setdefault(
+                    pod,
+                    {
+                        "score": 0.0,
+                        "blocks_matched": 0,
+                        "break_index": 0,
+                        "tiers": {},
+                    },
+                )
         explanation["pods"] = per_pod
         scores = {pod: detail["score"] for pod, detail in per_pod.items()}
         if self.capture is not None:
@@ -975,4 +1117,36 @@ class Indexer:
                 )
             except Exception:  # noqa: BLE001 — advice is advisory
                 logger.exception("tiering advice failed")
+        transfer = self.transfer_engine
+        if transfer is not None and per_pod:
+            # Transfer planning rides the RAW provenance (holders are
+            # holders regardless of their queue); plan_for_chain never
+            # raises into scoring (transfer/engine.py contract).
+            directive = transfer.plan_for_chain(
+                per_pod,
+                pod_loads,
+                block_keys,
+                token_ids=tokens,
+                block_size=getattr(
+                    self.token_processor, "block_size", 16
+                ),
+            )
+            if directive is not None:
+                explanation["transfer"] = directive
+        if pod_loads and self._load_blend > 0.0:
+            blended = self._blend_loads(scores, pod_loads)
+            explanation["load_blend"] = {
+                "coefficient": self._load_blend,
+                "pods": {
+                    pod: {
+                        "raw": scores[pod],
+                        "queue_depth": float(
+                            pod_loads.get(pod, 0.0)
+                        ),
+                        "blended": blended[pod],
+                    }
+                    for pod in sorted(scores)
+                },
+            }
+            scores = blended
         return scores, explanation

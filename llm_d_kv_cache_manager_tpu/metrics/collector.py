@@ -321,6 +321,38 @@ class KVCacheMetrics:
             "Age of the policy feed's current prediction snapshot.",
             registry=self.registry,
         )
+        # KV-transfer planning plane (transfer/; docs/transfer.md).
+        self.transfer_plans = Counter(
+            f"{_NAMESPACE}_transfer_plans_total",
+            "Transfer-planner decisions by outcome (planned / warmup / "
+            "holder-not-overloaded / no-holder / no-target / "
+            "too-few-blocks / no-block-bytes / no-rtt-observations / "
+            "recompute-cheaper / pod-invalidated / expired).",
+            ("outcome",),
+            registry=self.registry,
+        )
+        self.transfer_executions = Counter(
+            f"{_NAMESPACE}_transfer_executions_total",
+            "Executed transfer plans by outcome (copied / moved / "
+            "partial-copied / partial-moved / invalidated / stale).",
+            ("outcome",),
+            registry=self.registry,
+        )
+        self.transfer_bytes = Counter(
+            f"{_NAMESPACE}_transfer_bytes_total",
+            "Bytes moved pod-to-pod by executed transfer plans.",
+            registry=self.registry,
+        )
+        self.transfer_warmup_moves = Counter(
+            f"{_NAMESPACE}_transfer_warmup_moves_total",
+            "Hot-family pre-placements executed by the warm-up worker.",
+            registry=self.registry,
+        )
+        self.transfer_cold_pods = Gauge(
+            f"{_NAMESPACE}_transfer_cold_pods",
+            "Pods registered cold with warm-up transfers still pending.",
+            registry=self.registry,
+        )
         # Replicated index service (cluster/; docs/replication.md).
         self.cluster_ring_version = Gauge(
             f"{_NAMESPACE}_cluster_ring_version",

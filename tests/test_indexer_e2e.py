@@ -116,7 +116,12 @@ PROMPT = "the quick brown fox jumps over the lazy dog . " * 8
 class TestEndToEnd:
     def test_miss_then_hit(self, stack):
         indexer, event_pool = stack
-        assert indexer.get_pod_scores(PROMPT, MODEL, ["pod-1"]) == {}
+        # Filtered pods unknown to the index get explicit zero entries
+        # (not silently missing) so planner/ledger/explain agree on
+        # the candidate set.
+        assert indexer.get_pod_scores(PROMPT, MODEL, ["pod-1"]) == {
+            "pod-1": 0.0
+        }
 
         _, n_blocks = publish_prompt_blocks(
             indexer, event_pool, PROMPT, "pod-1"
@@ -174,13 +179,18 @@ class TestEndToEnd:
             )
         )
         event_pool.drain()
-        assert indexer.get_pod_scores(PROMPT, MODEL, ["pod-1"]) == {}
+        # Evicted chain scores zero; the filtered pod stays listed.
+        assert indexer.get_pod_scores(PROMPT, MODEL, ["pod-1"]) == {
+            "pod-1": 0.0
+        }
 
     def test_pod_filter(self, stack):
         indexer, event_pool = stack
         publish_prompt_blocks(indexer, event_pool, PROMPT, "pod-1")
         scores = indexer.get_pod_scores(PROMPT, MODEL, ["other-pod"])
-        assert scores == {}
+        # The holder is filtered out; the unknown requested pod gets
+        # an explicit zero entry rather than vanishing.
+        assert scores == {"other-pod": 0.0}
 
     def test_chat_completions_flow(self, stack):
         indexer, event_pool = stack

@@ -35,10 +35,17 @@ def test_forward_shapes_and_finite():
     reason="seed: was masked by the jax.shard_map AttributeError on "
     "jax 0.4.x until the PR-7 compat shim unblocked it; the MoE ring "
     "forward now runs but diverges from dense (~19% of logits, max "
-    "abs 0.02, einsum body included — the llama ring tests pass, so "
-    "this is MoE-specific, likely the capacity routing under a "
-    "sequence-sharded mesh).  Needs a real MoE-ring investigation "
-    "(ROADMAP maintenance)",
+    "abs 0.02, einsum body included).  Triage so far: the original "
+    "capacity-routing hypothesis is REFUTED — divergence is unchanged "
+    "with a no-drop capacity factor (cf=4/8), with n_experts=1, and "
+    "with top_k=n_experts, so neither capacity drops nor expert "
+    "selection is involved.  A single ring layer is EXACT at sp=8; "
+    "two layers diverge at any sp>=2.  The fault is in the "
+    "layer-to-layer activation handoff of the sp-sharded MoE forward "
+    "(llama's multi-layer ring passes, so the shared ring body is "
+    "fine), not ring attention math or routing.  Next step: diff "
+    "layer-1 outputs ring-vs-dense under the sp mesh (ROADMAP "
+    "maintenance)",
     strict=False,
 )
 def test_forward_ring_matches_dense():

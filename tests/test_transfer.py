@@ -1,0 +1,957 @@
+"""KV-transfer planning plane: planner, executor, warm-up, blending.
+
+Covers the tentpole's acceptance properties:
+
+* the planner decision table: every outcome label, the pricing rule
+  (transfer beats recompute by the margin or no plan), the zero-RTT
+  edge (no measurements -> recompute, never plan on a guess), plan
+  determinism under a fixed feed snapshot;
+* the executor's safety properties, end to end through the kvevents
+  pool (not unit-mocked): a copied plan flips the target pod's score
+  through real BlockStored events; a source that died mid-plan
+  invalidates the plan and publishes NOTHING (no phantom index
+  entries); the transfer-vs-demotion race (executor removes from the
+  tier the source holds NOW, not the tier the plan remembered);
+* instant-warm scale-out: hot-family catalog, cold-pod registration,
+  the budgeted drain, ledger-ranked family selection;
+* load-blended scoring: the LOAD_BLEND fold, bit-identical parity
+  when off, the explain surface;
+* the unknown-pod filter fix-up: filtered-but-absent pods get
+  explicit zero entries in the straight lane, the fast lane, and the
+  explained walk, so the planner/ledger/explain candidate sets agree;
+* the /debug/transfer endpoint, the /healthz transfer block, and the
+  planned scoring variant riding POST /score_completions.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from llm_d_kv_cache_manager_tpu.api.http_service import serve
+from llm_d_kv_cache_manager_tpu.analytics.ledger import (
+    CacheStatsLedger,
+    LedgerConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    Message,
+    Pool,
+    PoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tiering import (
+    AdvisorConfig,
+    ComputeOrLoadAdvisor,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import Encoding
+from llm_d_kv_cache_manager_tpu.transfer import (
+    DONE,
+    EXPIRED,
+    INVALIDATED,
+    HotFamilyCatalog,
+    TransferConfig,
+    TransferEngine,
+    TransferExecutor,
+    TransferPlanner,
+    WarmupWorker,
+)
+
+MODEL = "transfer-model"
+BLOCK_SIZE = 4
+
+
+class WordTokenizer:
+    """Deterministic whitespace tokenizer: 'tN' -> N."""
+
+    def type(self) -> str:
+        return "word"
+
+    def encode(self, prompt, model_name, add_special_tokens=True):
+        tokens, offsets, pos = [], [], 0
+        for word in prompt.split(" "):
+            tokens.append(int(word[1:]))
+            offsets.append((pos, pos + len(word)))
+            pos += len(word) + 1
+        return Encoding(tokens, offsets)
+
+
+def prompt_of(tokens) -> str:
+    return " ".join(f"t{t}" for t in tokens)
+
+
+def fed_advisor(
+    bytes_per_block=1024, prefill_rate=50.0, load_s=0.001, store_s=0.0005
+):
+    """Advisor with both RTT models fed: transfers price cheap."""
+    advisor = ComputeOrLoadAdvisor(
+        AdvisorConfig(
+            bytes_per_block=bytes_per_block,
+            block_tokens=BLOCK_SIZE,
+            prefill_tokens_per_s=prefill_rate,
+        )
+    )
+    if load_s is not None:
+        advisor.observe_load(4096, load_s)
+    if store_s is not None:
+        advisor.observe_store(4096, store_s)
+    return advisor
+
+
+def prov(score, blocks, tiers=None):
+    """One pod's scorer-explain provenance entry."""
+    return {
+        "score": score,
+        "blocks_matched": blocks,
+        "break_index": blocks,
+        "tiers": dict(tiers) if tiers else {"hbm": blocks},
+    }
+
+
+def make_planner(advisor=None, **kw):
+    kw.setdefault("load_threshold", 2.0)
+    return TransferPlanner(advisor or fed_advisor(), **kw)
+
+
+def make_stack(ledger=None, **config_kw):
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE
+            ),
+            tokenizers_pool_config=TokenizationPoolConfig(
+                workers=1, model_name=MODEL
+            ),
+            **config_kw,
+        ),
+        tokenizer=WordTokenizer(),
+        cache_stats_ledger=ledger,
+    )
+    indexer.run()
+    pool = Pool(
+        indexer.kv_block_index,
+        indexer.token_processor,
+        PoolConfig(concurrency=2),
+    )
+    pool.start()
+    return indexer, pool
+
+
+def publish(pool, pod, events):
+    pool.add_task(
+        Message(
+            topic=f"kv@{pod}@{MODEL}",
+            payload=EventBatch(ts=1.0, events=events).encode(),
+            pod_identifier=pod,
+            model_name=MODEL,
+        )
+    )
+    pool.drain()
+
+
+def seed_chain(pool, pod, engine_hashes, tokens, medium="hbm"):
+    publish(
+        pool,
+        pod,
+        [
+            BlockStored(
+                block_hashes=list(engine_hashes),
+                parent_block_hash=None,
+                token_ids=list(tokens),
+                block_size=BLOCK_SIZE,
+                medium=medium,
+            )
+        ],
+    )
+
+
+# ----------------------------- planner ----------------------------------
+
+
+class TestPlannerDecision:
+    KEYS = [11, 22, 33, 44]
+
+    def test_planned_happy_path(self):
+        planner = make_planner()
+        plan, outcome = planner.plan(
+            {"pod-1": prov(4.0, 4), "pod-2": prov(0.0, 0)},
+            {"pod-1": 5.0, "pod-2": 0.0},
+            self.KEYS,
+            token_ids=list(range(16)),
+            block_size=BLOCK_SIZE,
+        )
+        assert outcome == "planned"
+        assert plan.source_pod == "pod-1"
+        assert plan.target_pod == "pod-2"
+        assert plan.blocks == 4
+        assert plan.block_keys == self.KEYS
+        assert plan.nbytes == 4 * 1024
+        assert plan.est_transfer_s < plan.est_recompute_s
+        directive = plan.to_directive()
+        assert directive["plan_id"] == plan.plan_id
+        assert directive["block_keys"] == self.KEYS
+        assert planner.get(plan.plan_id) is plan
+
+    def test_holder_not_overloaded(self):
+        planner = make_planner()
+        plan, outcome = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 1.0, "pod-2": 0.0},
+            self.KEYS,
+        )
+        assert plan is None and outcome == "holder-not-overloaded"
+
+    def test_no_holder(self):
+        planner = make_planner()
+        plan, outcome = planner.plan(
+            {"pod-1": prov(0.0, 0)}, {"pod-1": 9.0}, self.KEYS
+        )
+        assert plan is None and outcome == "no-holder"
+
+    def test_too_few_blocks(self):
+        planner = make_planner(min_blocks=3)
+        plan, outcome = planner.plan(
+            {"pod-1": prov(2.0, 2)},
+            {"pod-1": 9.0, "pod-2": 0.0},
+            self.KEYS[:2],
+        )
+        assert plan is None and outcome == "too-few-blocks"
+
+    def test_no_target(self):
+        planner = make_planner()
+        # Every other pod is at least as loaded as the holder.
+        plan, outcome = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 5.0, "pod-2": 5.0},
+            self.KEYS,
+        )
+        assert plan is None and outcome == "no-target"
+
+    def test_no_target_without_headroom(self):
+        # Less loaded than the holder is not enough: a saturated pod
+        # is not a transfer target (load >= load_threshold / 2).
+        planner = make_planner(load_threshold=6.0)
+        plan, outcome = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 4.0},
+            self.KEYS,
+        )
+        assert plan is None and outcome == "no-target"
+        plan, outcome = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 2.0},
+            self.KEYS,
+        )
+        assert outcome == "planned" and plan.target_pod == "pod-2"
+
+    def test_recompute_cheaper(self):
+        advisor = fed_advisor(load_s=None, store_s=None)
+        advisor.observe_load(1024, 100.0)  # absurdly slow readback
+        planner = make_planner(advisor)
+        plan, outcome = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 0.0},
+            self.KEYS,
+        )
+        assert plan is None and outcome == "recompute-cheaper"
+
+    def test_no_block_bytes(self):
+        planner = make_planner(fed_advisor(bytes_per_block=0))
+        plan, outcome = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 0.0},
+            self.KEYS,
+        )
+        assert plan is None and outcome == "no-block-bytes"
+
+    def test_zero_rtt_estimator_never_plans(self):
+        # ISSUE edge case: no RTT measurements at all -> recompute is
+        # the only priced option; the planner must not plan on a guess.
+        advisor = fed_advisor(load_s=None, store_s=None)
+        assert advisor.rtt.estimate(4096) is None
+        planner = make_planner(advisor)
+        plan, outcome = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 0.0},
+            self.KEYS,
+        )
+        assert plan is None and outcome == "no-rtt-observations"
+        assert planner.stats()["outcomes"] == {"no-rtt-observations": 1}
+
+    def test_no_prefill_rate_still_plans_flagged(self):
+        advisor = ComputeOrLoadAdvisor(
+            AdvisorConfig(bytes_per_block=1024, block_tokens=BLOCK_SIZE)
+        )
+        advisor.observe_load(4096, 0.001)
+        advisor.observe_store(4096, 0.0005)
+        assert advisor.prefill_tokens_per_s is None
+        planner = make_planner(advisor)
+        plan, outcome = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 0.0},
+            self.KEYS,
+        )
+        assert outcome == "planned"
+        assert plan.reason == "no-prefill-rate"
+        assert plan.est_recompute_s is None
+
+    def test_determinism_under_fixed_snapshot(self):
+        # ISSUE edge case: two fresh planners fed the identical
+        # snapshot produce byte-identical directives (no wall clock,
+        # no randomness, counter ids, lexicographic tiebreaks).
+        per_pod = {
+            "pod-b": prov(4.0, 4),
+            "pod-a": prov(4.0, 4),  # score tie -> lexicographic holder
+            "pod-c": prov(0.0, 0),
+        }
+        loads = {"pod-a": 9.0, "pod-b": 9.0, "pod-c": 0.0, "pod-d": 0.0}
+        directives = []
+        for _ in range(2):
+            planner = make_planner(fed_advisor())
+            plan, outcome = planner.plan(
+                per_pod,
+                dict(loads),
+                self.KEYS,
+                token_ids=list(range(16)),
+                block_size=BLOCK_SIZE,
+                now=0.0,
+            )
+            assert outcome == "planned"
+            directives.append(plan.to_directive())
+        assert directives[0] == directives[1]
+        assert directives[0]["source_pod"] == "pod-a"
+        # min-load tiebreak is lexicographic too.
+        assert directives[0]["target_pod"] == "pod-c"
+
+    def test_replan_damping_in_flight(self):
+        # Scoring the same hot chain again while a plan is live must
+        # not mint a duplicate transfer (pool-thrash guard).
+        planner = make_planner()
+        snapshot = (
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 0.0},
+            self.KEYS,
+        )
+        plan, outcome = planner.plan(*snapshot, now=0.0)
+        assert outcome == "planned"
+        dup, outcome = planner.plan(*snapshot, now=0.0)
+        assert dup is None and outcome == "in-flight"
+        # After the plan lands, the same chain -> same target is still
+        # cooled down; a different chain is unaffected.
+        planner.mark(plan.plan_id, DONE)
+        dup, outcome = planner.plan(*snapshot, now=1.0)
+        assert dup is None and outcome == "recently-transferred"
+        other, outcome = planner.plan(
+            snapshot[0], snapshot[1], [77, 88, 99, 110], now=1.0
+        )
+        assert outcome == "planned" and other is not None
+
+    def test_replan_cooldown_expires(self):
+        planner = make_planner(replan_cooldown_s=5.0)
+        snapshot = (
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 0.0},
+            self.KEYS,
+        )
+        plan, _ = planner.plan(*snapshot, now=0.0)
+        planner.mark(plan.plan_id, DONE)
+        _, outcome = planner.plan(*snapshot, now=4.0)
+        assert outcome == "recently-transferred"
+        again, outcome = planner.plan(*snapshot, now=5.0)
+        assert outcome == "planned" and again.plan_id != plan.plan_id
+
+    def test_ttl_expiry(self):
+        planner = make_planner(ttl_s=10.0)
+        plan, _ = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 0.0},
+            self.KEYS,
+            now=0.0,
+        )
+        assert planner.expire(now=5.0) == 0
+        assert planner.expire(now=10.0) == 1
+        assert plan.state == EXPIRED
+
+    def test_invalidate_pod(self):
+        planner = make_planner()
+        plan, _ = planner.plan(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 9.0, "pod-2": 0.0},
+            self.KEYS,
+        )
+        assert planner.invalidate_pod("pod-3") == 0
+        assert planner.invalidate_pod("pod-2") == 1
+        assert plan.state == INVALIDATED
+
+    def test_registry_bounded(self):
+        planner = make_planner(max_plans=3)
+        for _ in range(5):
+            planner.plan_warmup("pod-1", "pod-2", self.KEYS)
+        stats = planner.stats()
+        assert stats["plans"] == 3
+        assert planner.get(1) is None and planner.get(5) is not None
+
+
+# ----------------------------- executor ---------------------------------
+
+
+class TestExecutor:
+    def _seeded(self, n_blocks=8):
+        indexer, pool = make_stack()
+        tokens = list(range(1, n_blocks * BLOCK_SIZE + 1))
+        engine_hashes = [0x7000 + i for i in range(n_blocks)]
+        seed_chain(pool, "pod-1", engine_hashes, tokens)
+        request_keys = indexer.token_processor.tokens_to_kv_block_keys(
+            0, tokens, MODEL
+        )
+        return indexer, pool, tokens, engine_hashes, request_keys
+
+    def test_copy_flips_target_score(self):
+        indexer, pool, tokens, hashes, keys = self._seeded()
+        try:
+            prompt = prompt_of(tokens)
+            before = indexer.get_pod_scores(
+                prompt, MODEL, ["pod-1", "pod-2"]
+            )
+            assert before == {"pod-1": 8.0, "pod-2": 0.0}
+            planner = make_planner()
+            plan = planner.plan_warmup(
+                "pod-1",
+                "pod-2",
+                keys,
+                engine_hashes=hashes,
+                token_ids=tokens,
+                block_size=BLOCK_SIZE,
+            )
+            executor = TransferExecutor(
+                indexer.kv_block_index, pool, MODEL
+            )
+            assert executor.execute(plan) is True
+            assert plan.state == DONE
+            pool.drain()
+            after = indexer.get_pod_scores(
+                prompt, MODEL, ["pod-1", "pod-2"]
+            )
+            # Copy: the target warms, the source keeps its residency.
+            assert after == {"pod-1": 8.0, "pod-2": 8.0}
+            # Re-executing a DONE plan is a stale no-op.
+            assert executor.execute(plan) is False
+        finally:
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_source_dies_mid_plan_no_phantom_entries(self):
+        # ISSUE edge case: the source evaporates between plan and
+        # execute.  The plan is invalidated and NO events flow — the
+        # target must not gain phantom residency.
+        indexer, pool, tokens, hashes, keys = self._seeded()
+        try:
+            planner = make_planner()
+            plan = planner.plan_warmup(
+                "pod-1",
+                "pod-2",
+                keys,
+                engine_hashes=hashes,
+                token_ids=tokens,
+                block_size=BLOCK_SIZE,
+            )
+            # Source dies: its whole chain is evicted.
+            publish(
+                pool,
+                "pod-1",
+                [BlockRemoved(block_hashes=hashes, medium="hbm")],
+            )
+            executor = TransferExecutor(
+                indexer.kv_block_index, pool, MODEL
+            )
+            assert executor.execute(plan) is False
+            assert plan.state == INVALIDATED
+            assert executor.stats()["invalidated"] == 1
+            pool.drain()
+            found = indexer.kv_block_index.lookup(keys)
+            assert found == {}, "phantom entries planted at the target"
+            scores = indexer.get_pod_scores(
+                prompt_of(tokens), MODEL, ["pod-2"]
+            )
+            assert scores == {"pod-2": 0.0}
+        finally:
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_transfer_vs_demotion_race_uses_current_tier(self):
+        # ISSUE edge case: a demotion moves the chain hbm -> host
+        # between plan and execute.  A move must remove the source's
+        # CURRENT entries (host); removing the plan-time tier (hbm)
+        # would leave the host residency behind forever.
+        indexer, pool, tokens, hashes, keys = self._seeded()
+        try:
+            planner = make_planner()
+            plan = planner.plan_warmup(
+                "pod-1",
+                "pod-2",
+                keys,
+                engine_hashes=hashes,
+                token_ids=tokens,
+                block_size=BLOCK_SIZE,
+                tier="hbm",  # plan-time observation, about to go stale
+            )
+            # Demotion worker moves the chain down a rung
+            # (store-before-remove, same as tiering/demotion.py).
+            publish(
+                pool,
+                "pod-1",
+                [
+                    BlockStored(
+                        block_hashes=hashes,
+                        parent_block_hash=None,
+                        token_ids=tokens,
+                        block_size=BLOCK_SIZE,
+                        medium="host",
+                    ),
+                    BlockRemoved(block_hashes=hashes, medium="hbm"),
+                ],
+            )
+            executor = TransferExecutor(
+                indexer.kv_block_index, pool, MODEL
+            )
+            assert executor.execute(plan, mode="move") is True
+            pool.drain()
+            found = indexer.kv_block_index.lookup(keys)
+            residency = {
+                (entry.pod_identifier, entry.device_tier)
+                for pods in found.values()
+                for entry in pods
+            }
+            # Source fully gone (removed at host, the tier it actually
+            # held), target warmed at hbm.
+            assert residency == {("pod-2", "hbm")}
+        finally:
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_partial_surviving_prefix(self):
+        indexer, pool, tokens, hashes, keys = self._seeded()
+        try:
+            planner = make_planner()
+            plan = planner.plan_warmup(
+                "pod-1",
+                "pod-2",
+                keys,
+                engine_hashes=hashes,
+                token_ids=tokens,
+                block_size=BLOCK_SIZE,
+            )
+            # The source evicts the tail half of the chain.
+            publish(
+                pool,
+                "pod-1",
+                [BlockRemoved(block_hashes=hashes[4:], medium="hbm")],
+            )
+            executor = TransferExecutor(
+                indexer.kv_block_index, pool, MODEL
+            )
+            assert executor.execute(plan) is True
+            pool.drain()
+            scores = indexer.get_pod_scores(
+                prompt_of(tokens), MODEL, ["pod-2"]
+            )
+            # Only the surviving 4-block prefix moved.
+            assert scores == {"pod-2": 4.0}
+        finally:
+            pool.shutdown()
+            indexer.shutdown()
+
+
+# ------------------------------ warm-up ---------------------------------
+
+
+class TestWarmup:
+    def test_catalog_longer_chain_wins(self):
+        catalog = HotFamilyCatalog(max_families=2)
+        catalog.note(1, "pod-1", [11, 22, 33], now=1.0)
+        # A shorter observation refreshes recency, keeps the chain.
+        catalog.note(1, "pod-2", [11], now=2.0)
+        record = catalog.get(1)
+        assert record.block_keys == [11, 22, 33]
+        assert record.holder_pod == "pod-1"
+        # A longer observation replaces it (and may change holder).
+        catalog.note(1, "pod-2", [11, 22, 33, 44], now=3.0)
+        assert catalog.get(1).holder_pod == "pod-2"
+        # Bounded: a third family evicts the oldest.
+        catalog.note(2, "pod-1", [55], now=4.0)
+        catalog.note(3, "pod-1", [66], now=5.0)
+        assert catalog.stats()["families"] == 2
+        assert catalog.get(1) is None
+
+    def test_ledger_ranked_families(self):
+        ledger = CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+        # Family 0xB is hotter (shorter reuse interval) than 0xA.
+        ledger.record(0xA, MODEL, 4, 4, now=0.0)
+        ledger.record(0xA, MODEL, 4, 4, now=10.0)
+        ledger.record(0xB, MODEL, 4, 4, now=8.0)
+        ledger.record(0xB, MODEL, 4, 4, now=10.0)
+        catalog = HotFamilyCatalog()
+        catalog.note(0xA, "pod-1", [1, 2])
+        catalog.note(0xB, "pod-1", [3, 4])
+        worker = WarmupWorker(
+            catalog, make_planner(), executor=None, ledger=ledger,
+            warmup_families=1,
+        )
+        assert worker._ranked_families() == [0xB]
+
+    def test_cold_pod_warms_through_real_events(self):
+        ledger = CacheStatsLedger(LedgerConfig(sample_rate=1.0))
+        indexer, pool = make_stack(ledger=ledger)
+        engine = TransferEngine(
+            advisor=fed_advisor(),
+            ledger=ledger,
+            config=TransferConfig(load_threshold=2.0, warmup_moves=2),
+        )
+        indexer.set_transfer_engine(engine)
+        engine.attach_executor(
+            indexer.kv_block_index, pool, MODEL, start_warmup=False
+        )
+        try:
+            tokens = list(range(1, 17))  # 4 blocks
+            hashes = [0x8800 + i for i in range(4)]
+            seed_chain(pool, "pod-1", hashes, tokens)
+            prompt = prompt_of(tokens)
+            # Scored traffic feeds the hot-family catalog.
+            for _ in range(2):
+                indexer.get_pod_scores_planned(
+                    prompt, MODEL, ["pod-1", "pod-2"]
+                )
+            assert engine.catalog.stats()["families"] == 1
+            # A new pod joins cold and registers.
+            queued = engine.register_cold_pod("pod-3")
+            assert queued == 1
+            assert engine.warmup.status()["cold_pods"] == {"pod-3": 1}
+            # The budgeted worker drains the queue; events are real.
+            assert engine.run_warmup_cycle() == 1
+            pool.drain()
+            scores = indexer.get_pod_scores(
+                prompt, MODEL, ["pod-1", "pod-3"]
+            )
+            assert scores["pod-3"] == scores["pod-1"] == 4.0
+            status = engine.warmup.status()
+            assert status["cold_pods"] == {}
+            assert status["warmed_moves"] == {"pod-3": 1}
+        finally:
+            engine.close()
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_register_cold_pod_skips_self_holder(self):
+        catalog = HotFamilyCatalog()
+        catalog.note(1, "pod-1", [11, 22])
+        worker = WarmupWorker(catalog, make_planner(), executor=None)
+        assert worker.register_cold_pod("pod-1") == 0
+
+
+# --------------------------- load blending ------------------------------
+
+
+class TestLoadBlend:
+    def _seeded_indexer(self, **config_kw):
+        indexer, pool = make_stack(**config_kw)
+        tokens = list(range(1, 17))
+        seed_chain(
+            pool, "pod-1", [0x9900 + i for i in range(4)], tokens
+        )
+        return indexer, pool, prompt_of(tokens)
+
+    def test_blend_divides_by_queue_depth(self):
+        indexer, pool, prompt = self._seeded_indexer(load_blend=0.5)
+        try:
+            scores = indexer.get_pod_scores(
+                prompt,
+                MODEL,
+                ["pod-1", "pod-2"],
+                pod_loads={"pod-1": 2.0},
+            )
+            # 4.0 / (1 + 0.5 * 2) = 2.0; unloaded pod untouched.
+            assert scores == {"pod-1": 2.0, "pod-2": 0.0}
+        finally:
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_parity_when_disabled(self):
+        indexer, pool, prompt = self._seeded_indexer(load_blend=0.0)
+        try:
+            plain = indexer.get_pod_scores(prompt, MODEL, ["pod-1"])
+            loaded = indexer.get_pod_scores(
+                prompt, MODEL, ["pod-1"], pod_loads={"pod-1": 50.0}
+            )
+            assert plain == loaded == {"pod-1": 4.0}
+        finally:
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_explain_shows_the_blend(self):
+        indexer, pool, prompt = self._seeded_indexer(load_blend=0.5)
+        try:
+            scores, detail = indexer.get_pod_scores_explained(
+                prompt,
+                MODEL,
+                ["pod-1"],
+                pod_loads={"pod-1": 2.0},
+            )
+            assert scores == {"pod-1": 2.0}
+            blend = detail["load_blend"]
+            assert blend["coefficient"] == 0.5
+            assert blend["pods"]["pod-1"] == {
+                "raw": 4.0,
+                "queue_depth": 2.0,
+                "blended": 2.0,
+            }
+        finally:
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_env_default(self, monkeypatch):
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+            _env_load_blend_default,
+        )
+
+        monkeypatch.delenv("LOAD_BLEND", raising=False)
+        assert _env_load_blend_default() == 0.0
+        monkeypatch.setenv("LOAD_BLEND", "0.25")
+        assert _env_load_blend_default() == 0.25
+        monkeypatch.setenv("LOAD_BLEND", "bogus")
+        assert _env_load_blend_default() == 0.0
+
+
+# ------------------------ unknown-pod zero-fill -------------------------
+
+
+class TestUnknownPodZeroFill:
+    def _check(self, **config_kw):
+        indexer, pool = make_stack(**config_kw)
+        try:
+            tokens = list(range(1, 17))
+            seed_chain(
+                pool, "pod-1", [0xAA00 + i for i in range(4)], tokens
+            )
+            prompt = prompt_of(tokens)
+            for _ in range(2):  # second pass exercises the memo lane
+                scores = indexer.get_pod_scores(
+                    prompt, MODEL, ["pod-1", "ghost-pod"]
+                )
+                assert scores == {"pod-1": 4.0, "ghost-pod": 0.0}
+            scores, detail = indexer.get_pod_scores_explained(
+                prompt, MODEL, ["pod-1", "ghost-pod"]
+            )
+            assert scores["ghost-pod"] == 0.0
+            # The explain provenance agrees with the score dict: the
+            # planner and the ledger see the same candidate set.
+            assert detail["pods"]["ghost-pod"] == {
+                "score": 0.0,
+                "blocks_matched": 0,
+                "break_index": 0,
+                "tiers": {},
+            }
+        finally:
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_fast_lane(self):
+        self._check(read_path_fast_lane=True)
+
+    def test_straight_lane(self):
+        self._check(read_path_fast_lane=False)
+
+
+# ---------------------- engine + planned variant ------------------------
+
+
+class TestTransferEngine:
+    def test_config_from_env(self, monkeypatch):
+        monkeypatch.setenv("TRANSFER_LOAD_THRESHOLD", "7.5")
+        monkeypatch.setenv("TRANSFER_MIN_BLOCKS", "3")
+        monkeypatch.setenv("TRANSFER_WARMUP_MOVES", "9")
+        monkeypatch.setenv("TRANSFER_TTL_S", "bogus")  # warn + default
+        config = TransferConfig.from_env()
+        assert config.load_threshold == 7.5
+        assert config.min_blocks == 3
+        assert config.warmup_moves == 9
+        assert config.ttl_s == 30.0
+
+    def test_plan_for_chain_directive_shape(self):
+        engine = TransferEngine(
+            advisor=fed_advisor(),
+            config=TransferConfig(load_threshold=2.0),
+        )
+        directive = engine.plan_for_chain(
+            {"pod-1": prov(4.0, 4), "pod-2": prov(0.0, 0)},
+            {"pod-1": 5.0, "pod-2": 0.0},
+            [11, 22, 33, 44],
+            token_ids=list(range(16)),
+            block_size=BLOCK_SIZE,
+        )
+        assert directive["planned"] is True
+        assert directive["outcome"] == "planned"
+        assert directive["source_pod"] == "pod-1"
+        assert directive["target_pod"] == "pod-2"
+        # The same call with a calm holder reports why it declined.
+        declined = engine.plan_for_chain(
+            {"pod-1": prov(4.0, 4)},
+            {"pod-1": 0.0},
+            [11, 22, 33, 44],
+        )
+        assert declined == {
+            "planned": False,
+            "outcome": "holder-not-overloaded",
+        }
+        # Either way the catalog learned the holder.
+        assert engine.catalog.stats()["families"] == 1
+
+    def test_planned_scoring_variant(self):
+        indexer, pool = make_stack()
+        engine = TransferEngine(
+            advisor=fed_advisor(),
+            config=TransferConfig(load_threshold=2.0),
+        )
+        indexer.set_transfer_engine(engine)
+        try:
+            tokens = list(range(1, 17))
+            seed_chain(
+                pool, "pod-1", [0xBB00 + i for i in range(4)], tokens
+            )
+            prompt = prompt_of(tokens)
+            scores, directive = indexer.get_pod_scores_planned(
+                prompt,
+                MODEL,
+                ["pod-1", "pod-2"],
+                pod_loads={"pod-1": 9.0, "pod-2": 0.0},
+            )
+            assert scores["pod-1"] == 4.0
+            assert directive["planned"] is True
+            assert directive["target_pod"] == "pod-2"
+        finally:
+            engine.close()
+            pool.shutdown()
+            indexer.shutdown()
+
+
+# ------------------------- HTTP debug surface ---------------------------
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as response:
+        return json.load(response)
+
+
+class TestTransferHttpSurface:
+    def test_debug_endpoint_healthz_and_planned_scoring(self):
+        indexer, pool = make_stack()
+        engine = TransferEngine(
+            advisor=fed_advisor(),
+            config=TransferConfig(load_threshold=2.0),
+        )
+        indexer.set_transfer_engine(engine)
+        server = serve(
+            indexer, host="127.0.0.1", port=0, transfer=engine
+        )
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            tokens = list(range(1, 17))
+            seed_chain(
+                pool, "pod-1", [0xCC00 + i for i in range(4)], tokens
+            )
+            reply = _post(
+                base,
+                "/score_completions",
+                {
+                    "prompt": prompt_of(tokens),
+                    "model": MODEL,
+                    "pods": ["pod-1", "pod-2"],
+                    "pod_loads": {"pod-1": 9.0, "pod-2": 0.0},
+                    "plan": True,
+                },
+            )
+            assert reply["scores"]["pod-1"] == 4.0
+            assert reply["transfer"]["planned"] is True
+            assert reply["transfer"]["target_pod"] == "pod-2"
+            with urllib.request.urlopen(
+                base + "/debug/transfer", timeout=10
+            ) as response:
+                payload = json.load(response)
+            assert payload["planner"]["outcomes"]["planned"] == 1
+            assert payload["catalog"]["families"] == 1
+            assert payload["config"]["load_threshold"] == 2.0
+            with urllib.request.urlopen(
+                base + "/healthz", timeout=10
+            ) as response:
+                health = json.load(response)
+            assert health["transfer"]["plans"] == 1
+            with urllib.request.urlopen(
+                base + "/debug", timeout=10
+            ) as response:
+                debug_index = json.load(response)
+            surfaces = {
+                row["path"]: row["enabled"]
+                for row in debug_index["surfaces"]
+            }
+            assert surfaces["/debug/transfer"] is True
+        finally:
+            server.shutdown()
+            engine.close()
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_debug_endpoint_404_when_disabled(self):
+        indexer, pool = make_stack()
+        server = serve(indexer, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            try:
+                urllib.request.urlopen(
+                    base + "/debug/transfer", timeout=10
+                )
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 404
+        finally:
+            server.shutdown()
+            pool.shutdown()
+            indexer.shutdown()
+
+    def test_malformed_pod_loads_rejected(self):
+        indexer, pool = make_stack()
+        server = serve(indexer, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            try:
+                _post(
+                    base,
+                    "/score_completions",
+                    {
+                        "prompt": "t1 t2",
+                        "model": MODEL,
+                        "pod_loads": {"pod-1": "busy"},
+                    },
+                )
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 400
+        finally:
+            server.shutdown()
+            pool.shutdown()
+            indexer.shutdown()
