@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke perf-trend profile-smoke events-smoke cachestats-smoke tiering-smoke transfer-smoke cluster-smoke offload-smoke replay-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint racefuzz-smoke lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke perf-trend profile-smoke events-smoke cachestats-smoke tiering-smoke transfer-smoke cluster-smoke offload-smoke replay-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -31,11 +31,27 @@ lint:
 # Project-invariant static analysis (hack/kvlint, stdlib-only; see
 # docs/static-analysis.md): per-file rules (lock discipline, tracer
 # safety, canonical serialization, blocking-in-async, swallowed
-# errors, shutdown discipline) plus the whole-program pass (lock-order
-# graph, contract-surface drift vs docs/) — one invocation, same as CI
-# and hooks/pre-commit.sh.
+# errors, shutdown discipline, split-lock atomicity, GIL-dependence)
+# plus the whole-program pass (lock-order graph, contract-surface
+# drift vs docs/) and the raceguard-manifest staleness pin — one
+# invocation, same as CI and hooks/pre-commit.sh.
 kvlint:
-	$(PYTHON) -m hack.kvlint llm_d_kv_cache_manager_tpu
+	$(PYTHON) -m hack.kvlint llm_d_kv_cache_manager_tpu --check-manifest
+
+# Preemption-fuzzed storms under guarded-by runtime enforcement
+# (hack/racefuzz.py; docs/static-analysis.md): two storms re-run with
+# raceguard armed, sys.setswitchinterval(1e-6) and seeded yield
+# injection at guarded-access/lock-acquire boundaries, plus the three
+# planted defects that prove the harness can see what it claims.
+# Bounded time, pinned seed — same invocation as CI's
+# "Race-certification smoke" step.
+racefuzz-smoke:
+	$(PYTHON) -m hack.racefuzz --plant guarded-write --seed 1337
+	$(PYTHON) -m hack.racefuzz --plant caller-locked --seed 1337
+	$(PYTHON) -m hack.racefuzz --plant check-then-act --seed 1337
+	$(PYTHON) -m hack.racefuzz --seed 1337 --time-budget 180 --storms \
+		tests/test_concurrency.py::TestBackendStorm \
+		tests/test_concurrency.py::TestShardedIndexStorm
 
 # Dynamic half of kvlint KV006 (same invocation as CI's "Lock-order
 # watchdog smoke" step): the concurrency storms plus the watchdog unit

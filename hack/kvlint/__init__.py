@@ -25,6 +25,12 @@ Per-file rules:
   in worker loops (kv005_except)
 * KV008 shutdown discipline — threads/executors/sockets a class
   creates need a reachable close/stop/shutdown path (kv008_resources)
+* KV009 atomicity — a guarded attr read under one lock acquisition
+  must not feed a write under a separate acquisition of the same lock
+  (check-then-act), unless ``# kvlint: atomic-ok`` (kv009_atomicity)
+* KV010 GIL-dependence — unguarded mutation of shared attrs on
+  lock-owning classes needs ``# gil-atomic: <why>``; the annotated
+  sites form the GIL-dependence inventory (kv010_gil)
 
 Whole-program rules (consume the project model):
 
@@ -53,6 +59,8 @@ from hack.kvlint import (
     kv006_lockorder,
     kv007_contracts,
     kv008_resources,
+    kv009_atomicity,
+    kv010_gil,
 )
 from hack.kvlint.base import Finding, SourceFile, SourceParseError
 from hack.kvlint.model import ProjectModel, build_model
@@ -64,6 +72,8 @@ RULES = (
     kv004_async,
     kv005_except,
     kv008_resources,
+    kv009_atomicity,
+    kv010_gil,
 )
 PROJECT_RULES = (
     kv006_lockorder,
@@ -117,24 +127,69 @@ def check_file(
     return findings
 
 
-def check_paths(
-    paths: Sequence[str], rules: Optional[Sequence[str]] = None
-) -> List[Finding]:
-    """Two-phase whole-program run: parse every file once, run the
-    per-file rules, build the project model, run the project rules."""
+def _parse_and_check(
+    path: str, rules: Optional[Sequence[str]]
+) -> "tuple[Optional[SourceFile], List[Finding]]":
+    """Parse one file ONCE and run the per-file rules over it; the
+    returned :class:`SourceFile` (tree + comments) is reused verbatim
+    by phase 1 (``build_model``) and the manifest/inventory emitters —
+    no path is ever read or parsed twice in a run."""
+    try:
+        source = _parse(path)
+    except SourceParseError as exc:
+        return None, [Finding(path, 0, "KV000", str(exc))]
+    findings: List[Finding] = []
+    for rule in RULES:
+        if rules and rule.RULE not in rules:
+            continue
+        findings.extend(rule.check(source))
+    return source, findings
+
+
+def _parse_and_check_job(item):
+    # ProcessPoolExecutor.map needs a single-argument top-level callable.
+    return _parse_and_check(*item)
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    jobs: int = 1,
+) -> "tuple[List[Finding], List[SourceFile]]":
+    """Two-phase whole-program run: parse every file once (in parallel
+    when ``jobs > 1``), run the per-file rules, build the project
+    model, run the project rules.  Returns the findings AND the parsed
+    sources so callers (manifest emission, staleness check, the GIL
+    inventory) share the same single pass.
+
+    ``jobs > 1`` fans the parse+per-file-rule stage out over a process
+    pool; ``map`` preserves submission order and the final sort is
+    total, so output is byte-identical to the sequential path (pinned
+    by the CLI contract test).
+    """
+    files = collect_files(paths)
+    rule_filter = tuple(rules) if rules else None
+    if jobs > 1 and len(files) > 1:
+        from concurrent.futures import ProcessPoolExecutor
+
+        with ProcessPoolExecutor(
+            max_workers=min(jobs, len(files))
+        ) as pool:
+            results = list(
+                pool.map(
+                    _parse_and_check_job,
+                    ((path, rule_filter) for path in files),
+                    chunksize=8,
+                )
+            )
+    else:
+        results = [_parse_and_check(path, rule_filter) for path in files]
     findings: List[Finding] = []
     sources: List[SourceFile] = []
-    for path in collect_files(paths):
-        try:
-            source = _parse(path)
-        except SourceParseError as exc:
-            findings.append(Finding(path, 0, "KV000", str(exc)))
-            continue
-        sources.append(source)
-        for rule in RULES:
-            if rules and rule.RULE not in rules:
-                continue
-            findings.extend(rule.check(source))
+    for source, file_findings in results:
+        findings.extend(file_findings)
+        if source is not None:
+            sources.append(source)
     if any(not rules or rule.RULE in rules for rule in PROJECT_RULES):
         model = build_model(sources, paths)
         for rule in PROJECT_RULES:
@@ -142,4 +197,11 @@ def check_paths(
                 continue
             findings.extend(rule.check_project(model))
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
-    return findings
+    return findings, sources
+
+
+def check_paths(
+    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Findings-only wrapper over :func:`analyze_paths`."""
+    return analyze_paths(paths, rules)[0]

@@ -1,17 +1,32 @@
 """CLI: ``python -m hack.kvlint [paths...]`` — see package docstring.
 
-Exit codes: 0 clean, 1 findings, 2 usage error.  Findings go to
-stdout as ``path:line: RULE: message`` (the format is pinned by a
-contract test); baseline/stale diagnostics go to stderr.
+Exit codes: 0 clean, 1 findings (or a stale manifest under
+``--check-manifest``), 2 usage error.  Findings go to stdout as
+``path:line: RULE: message`` (the format is pinned by a contract
+test); baseline/stale diagnostics go to stderr.
+
+Raceguard-plane emitters (docs/static-analysis.md):
+
+* ``--emit-manifest [FILE]`` — write the guarded-by manifest (phase
+  1's class→{guarded attrs, lock, caller-locked} model) to FILE, the
+  checked-in ``hack/kvlint/raceguard_manifest.json`` when omitted, or
+  stdout for ``-``; exits 0.
+* ``--check-manifest`` — additionally fail (exit 1) when the checked
+  in manifest is stale vs the annotations (CI + pre-commit shape).
+* ``--emit-gil-inventory [FILE]`` — write the GIL-dependence
+  inventory (every ``# gil-atomic:`` site) as JSON; stdout default.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
-from hack.kvlint import RULE_IDS, check_paths
+from hack.kvlint import RULE_IDS, analyze_paths
 from hack.kvlint import baseline as baseline_mod
+from hack.kvlint import kv010_gil
+from hack.kvlint import manifest as manifest_mod
 
 DEFAULT_PATHS = ("llm_d_kv_cache_manager_tpu",)
 
@@ -19,7 +34,7 @@ DEFAULT_PATHS = ("llm_d_kv_cache_manager_tpu",)
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m hack.kvlint",
-        description="Project-invariant static analysis (KV001-KV008).",
+        description="Project-invariant static analysis (KV001-KV010).",
     )
     parser.add_argument(
         "paths",
@@ -46,6 +61,39 @@ def main(argv=None) -> int:
         action="store_true",
         help="rewrite the baseline from current findings and exit 0",
     )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        metavar="N",
+        help="parse files on N worker processes (same output, pinned "
+        "by the contract test)",
+    )
+    parser.add_argument(
+        "--emit-manifest",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="FILE",
+        help="write the raceguard guarded-by manifest (default: the "
+        "checked-in hack/kvlint/raceguard_manifest.json; '-' = stdout) "
+        "and exit",
+    )
+    parser.add_argument(
+        "--check-manifest",
+        action="store_true",
+        help="fail when the checked-in raceguard manifest is stale "
+        "vs the # guarded-by: annotations",
+    )
+    parser.add_argument(
+        "--emit-gil-inventory",
+        nargs="?",
+        const="-",
+        default=None,
+        metavar="FILE",
+        help="write the GIL-dependence inventory ('-' = stdout, the "
+        "default) and exit",
+    )
     args = parser.parse_args(argv)
 
     rules = None
@@ -55,7 +103,46 @@ def main(argv=None) -> int:
         if unknown:
             parser.error(f"unknown rule(s): {', '.join(unknown)}")
 
-    findings = check_paths(args.paths, rules)
+    findings, sources = analyze_paths(args.paths, rules, jobs=args.jobs)
+
+    if args.emit_manifest is not None:
+        rendered = manifest_mod.render(
+            manifest_mod.build_manifest(sources, args.paths)
+        )
+        target = args.emit_manifest
+        if target == "":
+            target = manifest_mod.manifest_path(args.paths) or "-"
+        if target == "-":
+            sys.stdout.write(rendered)
+        else:
+            parent = os.path.dirname(os.path.abspath(target))
+            os.makedirs(parent, exist_ok=True)
+            with open(target, "w", encoding="utf-8") as handle:
+                handle.write(rendered)
+            print(f"kvlint: wrote manifest to {target}", file=sys.stderr)
+        return 0
+
+    if args.emit_gil_inventory is not None:
+        rendered = kv010_gil.render_inventory(
+            kv010_gil.collect_inventory(sources)
+        )
+        if args.emit_gil_inventory == "-":
+            sys.stdout.write(rendered)
+        else:
+            with open(
+                args.emit_gil_inventory, "w", encoding="utf-8"
+            ) as handle:
+                handle.write(rendered)
+            print(
+                "kvlint: wrote GIL-dependence inventory to "
+                f"{args.emit_gil_inventory}",
+                file=sys.stderr,
+            )
+        return 0
+
+    manifest_diags = []
+    if args.check_manifest:
+        manifest_diags = manifest_mod.check_stale(sources, args.paths)
 
     if args.write_baseline:
         count = baseline_mod.write(args.baseline, findings, rules=rules)
@@ -75,13 +162,15 @@ def main(argv=None) -> int:
         print(finding.format())
     for entry in stale:
         print(f"kvlint: stale baseline entry: {entry}", file=sys.stderr)
+    for diag in manifest_diags:
+        print(f"kvlint: {diag}", file=sys.stderr)
     if findings:
         print(
             f"kvlint: {len(findings)} finding"
             f"{'' if len(findings) == 1 else 's'}",
             file=sys.stderr,
         )
-    return 1 if findings else 0
+    return 1 if findings or manifest_diags else 0
 
 
 if __name__ == "__main__":

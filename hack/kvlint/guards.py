@@ -1,0 +1,194 @@
+"""Shared guarded-by model: one parser for every consumer.
+
+KV001 (lock discipline), KV009 (atomicity), KV010 (GIL dependence) and
+the raceguard manifest emitter all need the same facts about a class:
+which attributes are declared ``# guarded-by: <lock>``, which methods
+are caller-locked, and which attributes hold locks.  PR 2 kept that
+logic private to kv001_locks; this module is the single home so the
+static rules, the runtime manifest, and the docs can never drift on
+what the annotations *mean*.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from hack.kvlint.base import CALLER_LOCKED_MARK, SourceFile, dotted_name
+
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?:self\.)?([A-Za-z_]\w*)")
+DECL_ATTR_RE = re.compile(r"self\.([A-Za-z_]\w*)\s*[:=]")
+
+# `# gil-atomic: <why>` — a deliberate GIL-dependent mutation (KV010);
+# every annotated site feeds the machine-readable GIL-dependence
+# inventory (`--emit-gil-inventory`, the ROADMAP item-2 worklist).
+GIL_ATOMIC_RE = re.compile(r"#\s*gil-atomic:\s*(.+?)\s*$")
+
+# `# kvlint: atomic-ok` — a declared-benign check-then-act (KV009).
+ATOMIC_OK_MARK = "kvlint: atomic-ok"
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+
+
+def is_lock_call(node: ast.AST) -> bool:
+    """``threading.Lock()`` etc., optionally wrapped by
+    ``lockorder.tracked(threading.Lock(), ...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    callee = dotted_name(node.func)
+    if callee in _LOCK_FACTORIES:
+        return True
+    if callee and callee.rsplit(".", 1)[-1] == "tracked" and node.args:
+        return is_lock_call(node.args[0])
+    return False
+
+
+def class_span(cls: ast.ClassDef) -> range:
+    end = cls.lineno
+    for node in ast.walk(cls):
+        end = max(end, getattr(node, "end_lineno", 0) or 0)
+    return range(cls.lineno, end + 1)
+
+
+def collect_guards(source: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
+    """attr name -> guarding lock attr, from ``# guarded-by:`` comments
+    on ``self.<attr> = ...`` lines inside the class body."""
+    guards: Dict[str, str] = {}
+    for lineno in class_span(cls):
+        comment = source.comment_on(lineno)
+        if not comment:
+            continue
+        match = GUARDED_RE.search(comment)
+        if not match:
+            continue
+        decl = DECL_ATTR_RE.search(source.code_before_comment(lineno))
+        if decl:
+            guards[decl.group(1)] = match.group(1)
+    return guards
+
+
+def is_caller_locked(source: SourceFile, func: ast.AST) -> bool:
+    if func.name.endswith("_locked"):
+        return True
+    comment = source.comment_on(func.lineno)
+    return bool(comment and CALLER_LOCKED_MARK in comment)
+
+
+def caller_locked_methods(
+    source: SourceFile, cls: ast.ClassDef
+) -> List[str]:
+    """Names of the class's caller-locked methods (suffix or mark)."""
+    out: List[str] = []
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if is_caller_locked(source, item):
+                out.append(item.name)
+    return out
+
+
+def lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes the class assigns a lock to (``self.x = Lock()``),
+    anywhere in its body — the per-file twin of the project model's
+    ``ClassModel.lock_attrs``."""
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign) and is_lock_call(node.value):
+            targets = list(node.targets)
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and node.value is not None
+            and is_lock_call(node.value)
+        ):
+            targets = [node.target]
+        for target in targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                attrs.add(target.attr)
+            elif isinstance(target, ast.Name) and _in_class_body(
+                cls, node
+            ):
+                # Dataclass field: `_done_lock: Lock = field(...)` is an
+                # AnnAssign at class-body level — covered below via the
+                # dataclass-field walk, not here.
+                attrs.add(target.id)
+    # Dataclass lock fields: `x: threading.Lock = field(default_factory=
+    # threading.Lock)` at class-body level.
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            ann = dotted_name(node.annotation)
+            if ann and ann.rsplit(".", 1)[-1] in (
+                "Lock",
+                "RLock",
+                "Condition",
+            ):
+                attrs.add(node.target.id)
+    return attrs
+
+
+def _in_class_body(cls: ast.ClassDef, node: ast.AST) -> bool:
+    return node in cls.body
+
+
+_SYNC_FACTORIES = {
+    "Event",
+    "Semaphore",
+    "BoundedSemaphore",
+    "Barrier",
+    "Queue",
+    "SimpleQueue",
+    "LifoQueue",
+    "PriorityQueue",
+}
+
+
+def sync_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes holding internally-synchronized primitives
+    (``self._stop = threading.Event()`` etc.) — their mutator methods
+    (``clear``, ``put``…) are thread-safe by contract, so KV010 must
+    not read them as bare shared-state mutation."""
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if not (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        callee = dotted_name(node.value.func)
+        if not callee or callee.rsplit(".", 1)[-1] not in _SYNC_FACTORIES:
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                attrs.add(target.attr)
+    return attrs
+
+
+def with_locks(node: ast.With) -> Set[str]:
+    """Lock attr names acquired by ``with self.<lock>[, ...]:``."""
+    locks: Set[str] = set()
+    for item in node.items:
+        expr = item.context_expr
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            locks.add(expr.attr)
+    return locks

@@ -28,15 +28,20 @@ defeats the rule; don't do that).
 from __future__ import annotations
 
 import ast
-import re
-from typing import Dict, List, Set
+from typing import List, Set
 
-from hack.kvlint.base import CALLER_LOCKED_MARK, Finding, SourceFile
+from hack.kvlint import guards as guards_mod
+from hack.kvlint.base import Finding, SourceFile
 
 RULE = "KV001"
 
-_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?:self\.)?([A-Za-z_]\w*)")
-_DECL_ATTR_RE = re.compile(r"self\.([A-Za-z_]\w*)\s*[:=]")
+# The annotation grammar (regexes, caller-locked detection, class-span
+# walking) lives in hack/kvlint/guards.py, shared with KV009, KV010 and
+# the raceguard manifest emitter so every consumer reads the comments
+# identically.
+_collect_guards = guards_mod.collect_guards
+_is_caller_locked = guards_mod.is_caller_locked
+_with_locks = guards_mod.with_locks
 
 
 def check(source: SourceFile) -> List[Finding]:
@@ -45,51 +50,6 @@ def check(source: SourceFile) -> List[Finding]:
         if isinstance(node, ast.ClassDef):
             findings.extend(_check_class(source, node))
     return findings
-
-
-def _class_span(cls: ast.ClassDef) -> range:
-    end = cls.lineno
-    for node in ast.walk(cls):
-        end = max(end, getattr(node, "end_lineno", 0) or 0)
-    return range(cls.lineno, end + 1)
-
-
-def _collect_guards(source: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
-    """attr name -> guarding lock attr, from ``# guarded-by:`` comments
-    on ``self.<attr> = ...`` lines inside the class body."""
-    guards: Dict[str, str] = {}
-    for lineno in _class_span(cls):
-        comment = source.comment_on(lineno)
-        if not comment:
-            continue
-        match = _GUARDED_RE.search(comment)
-        if not match:
-            continue
-        decl = _DECL_ATTR_RE.search(source.code_before_comment(lineno))
-        if decl:
-            guards[decl.group(1)] = match.group(1)
-    return guards
-
-
-def _is_caller_locked(source: SourceFile, func: ast.AST) -> bool:
-    if func.name.endswith("_locked"):
-        return True
-    comment = source.comment_on(func.lineno)
-    return bool(comment and CALLER_LOCKED_MARK in comment)
-
-
-def _with_locks(node: ast.With) -> Set[str]:
-    """Lock attr names acquired by ``with self.<lock>[, ...]:``."""
-    locks: Set[str] = set()
-    for item in node.items:
-        expr = item.context_expr
-        if (
-            isinstance(expr, ast.Attribute)
-            and isinstance(expr.value, ast.Name)
-            and expr.value.id == "self"
-        ):
-            locks.add(expr.attr)
-    return locks
 
 
 def _check_class(source: SourceFile, cls: ast.ClassDef) -> List[Finding]:

@@ -1,0 +1,229 @@
+"""KV009 — check-then-act atomicity for guarded attributes.
+
+KV001 proves every guarded access holds the declared lock; it cannot
+see that a *decision* made under one acquisition is *acted on* under a
+different one:
+
+    with self._lock:
+        exists = key in self._data     # read
+    ...
+    with self._lock:
+        self._data[key] = value        # write — stale decision!
+
+Between the two ``with`` blocks any other thread may mutate ``_data``,
+so the write acts on a stale read — the classic lost-update /
+double-insert shape, and exactly the race class the GIL-escape plan
+(ROADMAP item 2) stops serializing.  This rule flags a guarded
+attribute that is read under one acquisition of its lock and written
+under a *later, separate* acquisition of the same lock in the same
+function.
+
+Deliberate over-approximation (documented): "feeds" is approximated by
+program order — any read-then-later-write pair across separate
+acquisitions counts, without proving data flow.  Benign pairs are
+declared with ``# kvlint: atomic-ok`` on the write line (or the line
+above), which — unlike a bare disable — asserts the author *checked*
+the interleaving.  ``__init__`` and caller-locked methods are exempt
+(one acquisition spans the whole call by contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Set, Tuple
+
+from hack.kvlint import guards
+from hack.kvlint.base import Finding, SourceFile
+
+RULE = "KV009"
+
+_MUTATORS = {
+    "append",
+    "add",
+    "extend",
+    "insert",
+    "update",
+    "setdefault",
+    "pop",
+    "popitem",
+    "remove",
+    "discard",
+    "clear",
+    "appendleft",
+    "popleft",
+}
+
+
+@dataclass
+class _Acquisition:
+    """One lexical ``with self.<lock>:`` entry (not already held)."""
+
+    lock: str
+    line: int
+    reads: Dict[str, int] = field(default_factory=dict)  # attr -> line
+    writes: Dict[str, int] = field(default_factory=dict)
+
+
+def check(source: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(source.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(source, node))
+    return findings
+
+
+def _check_class(source: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    guarded = guards.collect_guards(source, cls)
+    if not guarded:
+        return []
+    findings: List[Finding] = []
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if item.name == "__init__" or guards.is_caller_locked(
+            source, item
+        ):
+            continue
+        findings.extend(_check_function(source, guarded, item))
+    return findings
+
+
+def _check_function(
+    source: SourceFile,
+    guarded: Dict[str, str],
+    func: ast.AST,
+) -> List[Finding]:
+    acquisitions: List[_Acquisition] = []
+    nested_funcs: List[ast.AST] = []
+
+    def record_access(
+        node: ast.Attribute, held: Dict[str, _Acquisition], write: bool
+    ) -> None:
+        attr = node.attr
+        lock = guarded.get(attr)
+        if lock is None:
+            return
+        acq = held.get(lock)
+        if acq is None:
+            return  # unguarded access is KV001's finding, not ours
+        book = acq.writes if write else acq.reads
+        book.setdefault(attr, node.lineno)
+
+    def visit(node: ast.AST, held: Dict[str, _Acquisition]) -> None:
+        if isinstance(node, ast.ClassDef):
+            return
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            # A closure runs at an unknowable time relative to the
+            # enclosing acquisitions; analyze it as its own scope.
+            nested_funcs.append(node)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                visit(item.context_expr, held)
+            inner = dict(held)
+            for lock in sorted(
+                guards.with_locks(node) & set(guarded.values())
+            ):
+                if lock not in inner:  # re-entry is the same acquisition
+                    acq = _Acquisition(lock, node.lineno)
+                    acquisitions.append(acq)
+                    inner[lock] = acq
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            record_access(node, held, write)
+        if isinstance(node, ast.AugAssign):
+            target = _self_attr_of(node.target)
+            if target is not None:
+                record_access(target, held, True)
+        if isinstance(node, (ast.Subscript, ast.Call)):
+            target = _mutated_attr(node)
+            if target is not None:
+                record_access(target, held, True)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    body = func.body if isinstance(func.body, list) else [func.body]
+    for stmt in body:
+        visit(stmt, {})
+
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    by_lock: Dict[str, List[_Acquisition]] = {}
+    for acq in acquisitions:
+        by_lock.setdefault(acq.lock, []).append(acq)
+    for lock, acqs in by_lock.items():
+        for i, earlier in enumerate(acqs):
+            for later in acqs[i + 1:]:
+                for attr, read_line in sorted(earlier.reads.items()):
+                    write_line = later.writes.get(attr)
+                    if write_line is None:
+                        continue
+                    if (attr, write_line) in seen:
+                        continue
+                    seen.add((attr, write_line))
+                    if _atomic_ok(source, write_line):
+                        continue
+                    if source.suppressed(write_line, RULE):
+                        continue
+                    findings.append(
+                        Finding(
+                            source.path,
+                            write_line,
+                            RULE,
+                            f"check-then-act: 'self.{attr}' read "
+                            f"under 'with self.{lock}:' (line "
+                            f"{read_line}) feeds this write under a "
+                            "separate acquisition — merge into one "
+                            "critical section or mark `# kvlint: "
+                            "atomic-ok`",
+                        )
+                    )
+    for nested in nested_funcs:
+        findings.extend(_check_function(source, guarded, nested))
+    return findings
+
+
+def _self_attr_of(node: ast.AST) -> ast.Attribute | None:
+    """``self.x`` or ``self.x[...]`` -> the Attribute node."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node
+    return None
+
+
+def _mutated_attr(node: ast.AST) -> ast.Attribute | None:
+    """Attribute mutated through a subscript store/del or a known
+    mutator call (``self.x[k] = v``, ``self.x.append(v)``)."""
+    if isinstance(node, ast.Subscript) and isinstance(
+        node.ctx, (ast.Store, ast.Del)
+    ):
+        return _self_attr_of(node)
+    if isinstance(node, ast.Call) and isinstance(
+        node.func, ast.Attribute
+    ):
+        if node.func.attr in _MUTATORS:
+            return _self_attr_of(node.func.value)
+    return None
+
+
+def _atomic_ok(source: SourceFile, lineno: int) -> bool:
+    for line in (lineno, lineno - 1):
+        comment = source.comment_on(line)
+        if comment and guards.ATOMIC_OK_MARK in comment:
+            return True
+    return False

@@ -1,0 +1,291 @@
+"""KV010 — GIL-dependence must be declared, not implied.
+
+ROADMAP item 2 commits the scoring plane to escaping the GIL; the day
+it does, every mutation that today survives only because the
+interpreter serializes bytecodes becomes a real data race.  The
+codebase's deliberate lock-free idioms (PR 4's plain-int shard version
+bumps, single-reference snapshot swaps) were documented in prose only —
+invisible to tooling and to the migration.
+
+This rule makes the dependence explicit: on any class that declares a
+lock, a mutation of a *shared* attribute (referenced by more than one
+method) performed outside every ``with self.<lock>:`` block and not
+covered by a ``# guarded-by:`` declaration must carry
+
+    self._versions[shard] += 1  # gil-atomic: lone-writer counter
+
+on the mutation line or the line above.  The annotation does double
+duty: it asserts the author decided the site is GIL-safe, and it feeds
+the machine-readable **GIL-dependence inventory**
+(``python -m hack.kvlint --emit-gil-inventory``) that is item 2's
+migration worklist — each site must become atomic/locked/CAS when the
+GIL goes.
+
+Scope (documented, deliberate): mutations are ``self.attr = ...``,
+``self.attr op= ...``, ``self.attr[...] = ...``, ``del`` forms and
+known container-mutator calls; ``__init__``/``__post_init__`` and
+caller-locked methods are exempt; classes with no locks at all are out
+of scope (single-threaded by construction until someone adds a lock —
+at which point every pre-existing bare mutation surfaces, which is the
+desired ratchet).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from typing import Dict, List, Optional, Sequence, Set
+
+from hack.kvlint import guards
+from hack.kvlint.base import Finding, SourceFile
+
+RULE = "KV010"
+
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+_MUTATORS = {
+    "append",
+    "add",
+    "extend",
+    "insert",
+    "update",
+    "setdefault",
+    "pop",
+    "popitem",
+    "remove",
+    "discard",
+    "clear",
+    "appendleft",
+    "popleft",
+}
+
+
+def check(source: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(source.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(source, node))
+    return findings
+
+
+def _attr_refs_by_method(cls: ast.ClassDef) -> Dict[str, Set[str]]:
+    refs: Dict[str, Set[str]] = {}
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        names: Set[str] = set()
+        for node in ast.walk(item):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                names.add(node.attr)
+        refs[item.name] = names
+    return refs
+
+
+def _check_class(source: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    locks = guards.lock_attrs(cls)
+    guarded = guards.collect_guards(source, cls)
+    locks |= set(guarded.values())
+    if not locks:
+        return []
+    # Internally-synchronized primitives (Event, Queue, …): their
+    # mutators are thread-safe by contract, same standing as locks.
+    locks |= guards.sync_attrs(cls)
+    refs = _attr_refs_by_method(cls)
+    findings: List[Finding] = []
+    seen: Set[tuple] = set()  # (attr, line): AugAssign targets match twice
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if item.name in _EXEMPT_METHODS:
+            continue
+        if guards.is_caller_locked(source, item):
+            continue
+
+        def shared(attr: str, method: str = item.name) -> bool:
+            # __init__ referencing the attr does not make it shared:
+            # construction precedes publication (happens-before), so
+            # sharing requires a SECOND post-construction method.
+            return any(
+                attr in names
+                for name, names in refs.items()
+                if name != method and name not in _EXEMPT_METHODS
+            )
+
+        def flag(node: ast.Attribute) -> None:
+            attr = node.attr
+            if attr in guarded or attr in locks:
+                return
+            if not shared(attr):
+                return
+            if (attr, node.lineno) in seen:
+                return
+            seen.add((attr, node.lineno))
+            if _gil_atomic_why(source, node.lineno) is not None:
+                return
+            if source.suppressed(node.lineno, RULE):
+                return
+            findings.append(
+                Finding(
+                    source.path,
+                    node.lineno,
+                    RULE,
+                    f"unguarded write to shared 'self.{attr}' on a "
+                    "lock-owning class relies on the GIL — guard it, "
+                    "declare `# guarded-by:`, or annotate "
+                    "`# gil-atomic: <why>` to enter the "
+                    "GIL-dependence inventory",
+                )
+            )
+
+        def visit(node: ast.AST, held: bool) -> None:
+            if isinstance(node, ast.ClassDef):
+                return
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                # Same soundness rule as KV001/KV009: a closure can
+                # escape its `with` block, so it never inherits.
+                body = (
+                    node.body
+                    if isinstance(node.body, list)
+                    else [node.body]
+                )
+                for stmt in body:
+                    visit(stmt, False)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for with_item in node.items:
+                    visit(with_item.context_expr, held)
+                inner = held or bool(guards.with_locks(node) & locks)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if not held:
+                target = _mutation_target(node)
+                if target is not None:
+                    flag(target)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in item.body:
+            visit(stmt, False)
+    return findings
+
+
+def _self_attr_of(node: ast.AST) -> Optional[ast.Attribute]:
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node
+    return None
+
+
+def _mutation_target(node: ast.AST) -> Optional[ast.Attribute]:
+    if isinstance(node, (ast.Attribute, ast.Subscript)) and isinstance(
+        node.ctx, (ast.Store, ast.Del)
+    ):
+        return _self_attr_of(node)
+    if isinstance(node, ast.AugAssign):
+        return _self_attr_of(node.target)
+    if isinstance(node, ast.Call) and isinstance(
+        node.func, ast.Attribute
+    ):
+        if node.func.attr in _MUTATORS:
+            return _self_attr_of(node.func.value)
+    return None
+
+
+def _gil_atomic_why(source: SourceFile, lineno: int) -> Optional[str]:
+    for line in (lineno, lineno - 1):
+        comment = source.comment_on(line)
+        if comment:
+            match = guards.GIL_ATOMIC_RE.search(comment)
+            if match:
+                return match.group(1)
+    return None
+
+
+def _mutations_by_line(source: SourceFile) -> Dict[int, str]:
+    """Line -> mutated self attr, every line the *statement* spans, so
+    an annotation on the closing paren of a multi-line assignment still
+    resolves its attribute."""
+    mut_at: Dict[int, str] = {}
+
+    def record(stmt: ast.stmt, target: Optional[ast.Attribute]) -> None:
+        if target is None:
+            return
+        end = getattr(stmt, "end_lineno", None) or stmt.lineno
+        for lineno in range(stmt.lineno, end + 1):
+            mut_at.setdefault(lineno, target.attr)
+
+    for node in ast.walk(source.tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                record(node, _self_attr_of(tgt))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            record(node, _self_attr_of(node.target))
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                record(node, _self_attr_of(tgt))
+        elif isinstance(node, ast.Expr):
+            record(node, _mutation_target(node.value))
+    return mut_at
+
+
+# -- GIL-dependence inventory -------------------------------------------
+
+
+def collect_inventory(
+    sources: Sequence[SourceFile],
+) -> List[Dict[str, object]]:
+    """Every ``# gil-atomic:`` site in the analyzed set — the ROADMAP
+    item-2 migration worklist, one entry per annotated line."""
+    sites: List[Dict[str, object]] = []
+    for source in sources:
+        class_at: Dict[int, str] = {}
+        for node in ast.walk(source.tree):
+            if isinstance(node, ast.ClassDef):
+                for lineno in guards.class_span(node):
+                    class_at.setdefault(lineno, node.name)
+        mut_at = _mutations_by_line(source)
+        for lineno, (_, comment) in sorted(source.comments.items()):
+            match = guards.GIL_ATOMIC_RE.search(comment)
+            if not match:
+                continue
+            code = source.code_before_comment(lineno).strip()
+            if not code and lineno < len(source.lines):
+                # Annotation on its own line covers the line below.
+                code = source.lines[lineno].strip()
+            attr = mut_at.get(lineno) or mut_at.get(lineno + 1)
+            if attr is None:
+                decl = guards.DECL_ATTR_RE.search(code)
+                attr = decl.group(1) if decl else None
+            sites.append(
+                {
+                    "path": source.path,
+                    "line": lineno,
+                    "class": class_at.get(lineno),
+                    "attr": attr,
+                    "why": match.group(1),
+                    "code": code,
+                }
+            )
+    sites.sort(key=lambda s: (s["path"], s["line"]))
+    return sites
+
+
+def render_inventory(sites: List[Dict[str, object]]) -> str:
+    return (
+        json.dumps(
+            {"version": 1, "sites": sites}, indent=2, sort_keys=True
+        )
+        + "\n"
+    )

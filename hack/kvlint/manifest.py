@@ -1,0 +1,154 @@
+"""Raceguard manifest: kvlint's guarded-by model, exported for runtime.
+
+Phase 1 already knows, for every class, which attributes are declared
+``# guarded-by: <lock>``, which attributes hold locks, and which
+methods are caller-locked.  ``build_manifest`` serializes that model
+keyed by *importable* dotted class path so
+``llm_d_kv_cache_manager_tpu/utils/raceguard.py`` can import each class
+and instrument it when ``KVTPU_RACEGUARD=1`` — the static contract
+becomes an executable one.
+
+The rendered JSON is byte-deterministic (sorted keys, fixed indent), so
+the checked-in copy (``hack/kvlint/raceguard_manifest.json``) can be
+staleness-pinned: ``python -m hack.kvlint --check-manifest`` (CI, the
+pre-commit hook, and a tier-1 test) re-derives it from source and fails
+on any drift, exactly like the kvlint baseline contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from hack.kvlint import guards
+from hack.kvlint.base import SourceFile
+from hack.kvlint.model import find_project_root
+
+MANIFEST_VERSION = 1
+
+# Checked-in location, relative to the repo root.
+MANIFEST_RELPATH = os.path.join("hack", "kvlint", "raceguard_manifest.json")
+
+
+def module_name(path: str, root: Optional[str]) -> Optional[str]:
+    """Importable dotted module for ``path`` relative to ``root``.
+
+    ``pkg/sub/mod.py`` -> ``pkg.sub.mod``; ``pkg/__init__.py`` ->
+    ``pkg``.  None when the path escapes the root (not importable from
+    the repo checkout — such classes can't be instrumented and are
+    skipped rather than guessed at).
+    """
+    abspath = os.path.abspath(path)
+    if root is None:
+        return None
+    rel = os.path.relpath(abspath, root)
+    if rel.startswith(os.pardir):
+        return None
+    rel = rel[: -len(".py")] if rel.endswith(".py") else rel
+    parts = rel.split(os.sep)
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not all(part.isidentifier() for part in parts):
+        return None
+    return ".".join(parts)
+
+
+def _class_entries(
+    source: SourceFile, module: str
+) -> Dict[str, Dict[str, object]]:
+    """Dotted class path -> manifest entry, nested classes included."""
+    entries: Dict[str, Dict[str, object]] = {}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                qual = f"{prefix}{child.name}"
+                guarded = guards.collect_guards(source, child)
+                if guarded:
+                    entries[f"{module}:{qual}"] = {
+                        "guarded": dict(sorted(guarded.items())),
+                        "locks": sorted(guards.lock_attrs(child)),
+                        "caller_locked": sorted(
+                            guards.caller_locked_methods(source, child)
+                        ),
+                    }
+                walk(child, f"{qual}.")
+            elif isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # Classes defined inside functions are not importable
+                # by dotted path; raceguard can't reach them.
+                continue
+            else:
+                walk(child, prefix)
+
+    walk(source.tree, "")
+    return entries
+
+
+def build_manifest(
+    sources: Sequence[SourceFile], paths: Sequence[str]
+) -> Dict[str, object]:
+    root = find_project_root(paths)
+    classes: Dict[str, Dict[str, object]] = {}
+    for source in sources:
+        module = module_name(source.path, root)
+        if module is None:
+            continue
+        classes.update(_class_entries(source, module))
+    return {
+        "version": MANIFEST_VERSION,
+        "classes": {key: classes[key] for key in sorted(classes)},
+    }
+
+
+def render(manifest: Dict[str, object]) -> str:
+    return json.dumps(manifest, indent=2, sort_keys=True) + "\n"
+
+
+def manifest_path(paths: Sequence[str]) -> Optional[str]:
+    root = find_project_root(paths)
+    if root is None:
+        return None
+    return os.path.join(root, MANIFEST_RELPATH)
+
+
+def check_stale(
+    sources: Sequence[SourceFile], paths: Sequence[str]
+) -> List[str]:
+    """Empty when the checked-in manifest matches the sources; else a
+    list of human-readable diagnostics (missing file counts too)."""
+    target = manifest_path(paths)
+    if target is None:
+        return ["--check-manifest: no project root (docs/) found"]
+    expected = render(build_manifest(sources, paths))
+    try:
+        with open(target, encoding="utf-8") as handle:
+            current = handle.read()
+    except OSError:
+        return [
+            f"{os.path.relpath(target)}: missing — regenerate with "
+            "`python -m hack.kvlint --emit-manifest`"
+        ]
+    if current == expected:
+        return []
+    try:
+        have = json.loads(current)
+    except ValueError:
+        have = {"classes": {}}
+    want = json.loads(expected)
+    have_classes = have.get("classes", {})
+    want_classes = want.get("classes", {})
+    changed = sorted(
+        key
+        for key in set(have_classes) | set(want_classes)
+        if have_classes.get(key) != want_classes.get(key)
+    )
+    detail = ", ".join(changed[:4]) + ("…" if len(changed) > 4 else "")
+    return [
+        f"{os.path.relpath(target)}: stale vs `# guarded-by:` "
+        f"annotations ({detail or 'formatting'}) — regenerate with "
+        "`python -m hack.kvlint --emit-manifest`"
+    ]

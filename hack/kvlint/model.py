@@ -52,15 +52,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from hack.kvlint.base import SourceFile, dotted_name
-
-_LOCK_FACTORIES = {
-    "threading.Lock",
-    "threading.RLock",
-    "threading.Condition",
-    "Lock",
-    "RLock",
-    "Condition",
-}
+from hack.kvlint.guards import is_lock_call as _is_lock_call
 
 _METRIC_FACTORIES = {"Counter", "Gauge", "Histogram", "Summary"}
 
@@ -711,19 +703,6 @@ def _module_owner(path: str) -> str:
     node would invent self-edges that exist in no program."""
     rel = os.path.splitext(path)[0].replace(os.sep, ".").lstrip(".")
     return f"module:{rel}"
-
-
-def _is_lock_call(node: ast.AST) -> bool:
-    """``threading.Lock()`` etc., optionally wrapped by
-    ``lockorder.tracked(threading.Lock(), ...)``."""
-    if not isinstance(node, ast.Call):
-        return False
-    callee = dotted_name(node.func)
-    if callee in _LOCK_FACTORIES:
-        return True
-    if callee and callee.rsplit(".", 1)[-1] == "tracked" and node.args:
-        return _is_lock_call(node.args[0])
-    return False
 
 
 def _resource_kind(node: ast.AST) -> Optional[str]:

@@ -9,6 +9,13 @@ cd "$(git rev-parse --show-toplevel)"
 echo "-> lint"
 make lint
 
+echo "-> raceguard manifest (regenerate if annotations changed)"
+if ! python -m hack.kvlint llm_d_kv_cache_manager_tpu --check-manifest \
+    >/dev/null 2>&1; then
+  python -m hack.kvlint llm_d_kv_cache_manager_tpu --emit-manifest
+  git add hack/kvlint/raceguard_manifest.json
+fi
+
 echo "-> kvlint (project invariants)"
 make kvlint
 

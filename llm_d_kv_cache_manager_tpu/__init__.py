@@ -23,3 +23,13 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (  # noqa
     ChunkedTokenDatabase,
     TokenProcessorConfig,
 )
+
+# Guarded-by runtime enforcement (KVTPU_RACEGUARD=1): instrument every
+# manifest class at import time so all later constructions are covered.
+# Unarmed this is a single env check — no manifest read, no descriptors.
+import os as _os  # noqa: E402
+
+if _os.environ.get("KVTPU_RACEGUARD", "") in ("1", "true", "yes"):
+    from llm_d_kv_cache_manager_tpu.utils import raceguard as _raceguard
+
+    _raceguard.install_from_env()

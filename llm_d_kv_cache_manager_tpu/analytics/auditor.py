@@ -188,6 +188,7 @@ class IndexAuditor:
             return
         with self._lock:
             self._stopping = False
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._thread = threading.Thread(
             target=self._run, name="kvtpu-index-auditor", daemon=True
         )
@@ -199,6 +200,7 @@ class IndexAuditor:
             self._lock.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._thread = None
 
     def _run(self) -> None:
@@ -437,7 +439,10 @@ class IndexAuditor:
                 pod for pod in self._ratio_by_pod if pod not in current
             ]
             for pod in departed:
-                del self._ratio_by_pod[pod]
+                # The earlier read is in the mutually-exclusive
+                # empty-index early-return branch; this block derives
+                # `departed` under its own acquisition.
+                del self._ratio_by_pod[pod]  # kvlint: atomic-ok
             self._cycles += 1
             self._last_cycle_unix = time.time()
         for pod in departed:

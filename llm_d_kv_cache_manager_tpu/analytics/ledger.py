@@ -541,6 +541,7 @@ class CacheStatsLedger:
             child = self._tier_children.get(tier)
             if child is None:
                 child = METRICS.cachestats_tier_hits.labels(tier=tier)
+                # gil-atomic: idempotent memo; racing put re-derives the same value
                 self._tier_children[tier] = child
             child.inc(count)
         if any(reuse):
@@ -627,8 +628,10 @@ class CacheStatsLedger:
             return True
         tick = self._tier_tick + 1
         if tick >= sample:
+            # gil-atomic: sampling tick; a lost update only shifts the sampled request
             self._tier_tick = 0
             return True
+        # gil-atomic: sampling tick; a lost update only shifts the sampled request
         self._tier_tick = tick
         return False
 

@@ -154,6 +154,7 @@ class RemoteIndex(Index):
         child = self._latency_children.get(method)
         if child is None:
             child = METRICS.cluster_rpc_latency.labels(method=method)
+            # gil-atomic: idempotent memo; racing put re-derives the same value
             self._latency_children[method] = child
         return child
 

@@ -296,7 +296,12 @@ class ReplicationFollower:
             else:
                 skipped += 1
         with self._lock:
-            self._position = new_position
+            # sync_once is single-consumer (one sync thread; tests
+            # call it inline, never concurrently) — the lock only
+            # publishes position/stats to status readers, so the
+            # read-process-write spanning two acquisitions cannot
+            # interleave with another advance.
+            self._position = new_position  # kvlint: atomic-ok
             self._applied += applied
             self._skipped += skipped
             self._last_lag = len(records)
@@ -329,6 +334,7 @@ class ReplicationFollower:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._thread = threading.Thread(
             target=self._run,
             name=f"kvtpu-cluster-follow-{self.peer_id}",
@@ -340,6 +346,7 @@ class ReplicationFollower:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._thread = None
 
     def _run(self) -> None:

@@ -99,6 +99,7 @@ class _PodCache:
             return len(victims), len(self.entries) == 0
 
     def snapshot(self) -> Sequence[PodEntry]:
+        # gil-atomic: single ref read; a stale None only costs a rebuild
         snap = self._snap
         if snap is None:
             with self.lock:
@@ -209,6 +210,7 @@ class InMemoryIndex(Index):
             cache = self._group_cache
             if len(cache) >= self._GROUP_CACHE_MAX:
                 cache.clear()
+            # gil-atomic: single-key dict put; value is pure in the key
             cache[id(request_keys)] = (request_keys, groups)
         return groups
 
@@ -218,6 +220,8 @@ class InMemoryIndex(Index):
         mutation is visible)."""
         versions = self._versions
         for shard_index in shard_indices:
+            # gil-atomic: lone-advance counter; a lost ++ still differs
+            # from every vector captured before this bump
             versions[shard_index] += 1
 
     def version_vector(self) -> Tuple[int, ...]:

@@ -94,7 +94,9 @@ class RespClient:
                 sock = self._ssl_context.wrap_socket(
                     sock, server_hostname=ep.host
                 )
+        # gil-atomic: connect/close are single-owner (caller-serialized)
         self._sock = sock
+        # gil-atomic: connect/close are single-owner (caller-serialized)
         self._reader = sock.makefile("rb")
         self._handshake()
 
@@ -127,12 +129,14 @@ class RespClient:
                 self._reader.close()
             except OSError:
                 pass
+            # gil-atomic: connect/close are single-owner (caller-serialized)
             self._reader = None
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
+            # gil-atomic: connect/close are single-owner (caller-serialized)
             self._sock = None
 
     @staticmethod

@@ -203,6 +203,7 @@ class _Poller:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._thread = threading.Thread(
             target=self._run,
             name=f"kvtpu-evplane-poller-{self.index}",
@@ -214,6 +215,7 @@ class _Poller:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._thread = None
 
     def assigned(self) -> int:

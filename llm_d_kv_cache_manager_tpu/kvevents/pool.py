@@ -761,6 +761,7 @@ class Pool:
         after construction — embedders that build the recorder late.
         Racy-benign: enqueueing threads read the attribute once per
         batch."""
+        # gil-atomic: single ref store; enqueuers read one snapshot per batch
         self._capture = capture
 
     def start(self) -> None:
@@ -816,6 +817,7 @@ class Pool:
         if shard is None:
             shard = fnv1a_32(pod_identifier.encode()) % len(self._queues)
             if len(self._shard_cache) < 131072:
+                # gil-atomic: idempotent memo; value is a pure function of the key
                 self._shard_cache[pod_identifier] = shard
         return shard
 
@@ -829,6 +831,7 @@ class Pool:
                 pod=safe_label(pod_identifier)
             )
             if len(self._backlog_gauges) < 131072:
+                # gil-atomic: idempotent memo; racing put re-derives the same value
                 self._backlog_gauges[pod_identifier] = gauge
         return gauge
 
@@ -839,6 +842,7 @@ class Pool:
                 pod=safe_label(pod_identifier)
             )
             if len(self._shed_counters) < 131072:
+                # gil-atomic: idempotent memo; racing put re-derives the same value
                 self._shed_counters[pod_identifier] = counter
         return counter
 

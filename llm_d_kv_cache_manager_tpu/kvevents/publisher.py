@@ -251,4 +251,5 @@ class Publisher:
         self._flusher_stop.set()
         if self._flusher is not None:
             self._flusher.join(timeout=5)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._flusher = None

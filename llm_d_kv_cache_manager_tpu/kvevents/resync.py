@@ -224,6 +224,7 @@ class ResyncManager:
             return
         with self._lock:
             self._stopping = False
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._thread = threading.Thread(
             target=self._run, name="kvtpu-evplane-resync", daemon=True
         )
@@ -235,6 +236,7 @@ class ResyncManager:
             self._lock.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._thread = None
 
     # -- worker ----------------------------------------------------------

@@ -156,6 +156,7 @@ class SamplingProfiler:
         self._stop.clear()
         with self._lock:
             self._started_at = time.time()
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._thread = threading.Thread(
             target=self._run, name="kvtpu-profiler", daemon=True
         )
@@ -167,6 +168,7 @@ class SamplingProfiler:
         thread = self._thread
         if thread is not None:
             thread.join(timeout=5)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._thread = None
 
     def running(self) -> bool:

@@ -547,6 +547,7 @@ class SloEngine:
                 except Exception:  # noqa: BLE001 - the loop must survive
                     logger.exception("SLO evaluation round failed")
 
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._thread = threading.Thread(
             target=run, name="kvtpu-slo-engine", daemon=True
         )
@@ -556,6 +557,7 @@ class SloEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._thread = None
 
 

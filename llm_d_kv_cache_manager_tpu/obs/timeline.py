@@ -113,6 +113,7 @@ class GaugeTimeline:
         if self._thread is not None and self._thread.is_alive():
             return True
         self._stop.clear()
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._thread = threading.Thread(
             target=self._run, name="kvtpu-timeline", daemon=True
         )
@@ -124,6 +125,7 @@ class GaugeTimeline:
         thread = self._thread
         if thread is not None:
             thread.join(timeout=5)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._thread = None
 
     def running(self) -> bool:

@@ -456,6 +456,7 @@ class Tracer:
         with self._lock:
             self._sampled = 0
             self._dropped = 0
+        # gil-atomic: delegates to the recorder's own internal lock
         self.recorder.clear()
 
 

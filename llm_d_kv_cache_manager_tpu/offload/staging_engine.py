@@ -376,7 +376,10 @@ class StagingEngine:
                 )
                 status = JobStatus.FAILED
         with self._cond:
-            sub = self._subs.pop(sub_id, None)
+            # Double-check shape: the scatter must run OUTSIDE _cond,
+            # and this second acquisition re-validates via pop() — a
+            # racing finisher gets None and bails.
+            sub = self._subs.pop(sub_id, None)  # kvlint: atomic-ok
             if sub is None:
                 return
             sub.status = status
@@ -536,6 +539,7 @@ class StagingEngine:
                     "host transfers",
                     exc_info=True,
                 )
+                # gil-atomic: one-way degrade flag; False is absorbing
                 self._use_pinned = False
         host = self.pool.gather_block_major(ids)
         slot.pinned_ref = host

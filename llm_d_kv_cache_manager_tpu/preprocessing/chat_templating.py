@@ -56,9 +56,12 @@ class ChatTemplatingProcessor:
                 load_auto_tokenizer,
             )
 
-            tokenizer = load_auto_tokenizer(model, revision=revision)
+            loaded = load_auto_tokenizer(model, revision=revision)
             with self._lock:
-                self._tokenizers[key] = tokenizer
+                # Two threads may both load; setdefault re-decides
+                # under the lock so the first insert wins and both
+                # callers share one instance.  # kvlint: atomic-ok
+                tokenizer = self._tokenizers.setdefault(key, loaded)
         return tokenizer
 
     def register_tokenizer(

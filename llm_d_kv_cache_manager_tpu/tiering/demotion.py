@@ -353,6 +353,7 @@ class DemotionWorker:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._thread = threading.Thread(
             target=self._run, name="kvtpu-tiering-demotion", daemon=True
         )
@@ -363,6 +364,7 @@ class DemotionWorker:
         thread = self._thread
         if thread is not None:
             thread.join(timeout=5)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._thread = None
 
     def _run(self) -> None:

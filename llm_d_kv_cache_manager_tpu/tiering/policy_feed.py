@@ -212,6 +212,7 @@ class PolicyFeed:
     def bind_ledger(self, ledger) -> None:
         """Late ledger attachment (the Indexer constructs its own
         ledger; the engine binds after)."""
+        # gil-atomic: late-bind wiring; single ref store before traffic
         self._ledger = ledger
 
     @property
@@ -390,7 +391,9 @@ class PolicyFeed:
         snapshot = PolicySnapshot(
             at=now, key_family=key_family, predictions=predictions
         )
+        # gil-atomic: immutable snapshot swap; readers see old or new
         self._snapshot = snapshot
+        # gil-atomic: stats counter; refresh is single-threaded
         self._refreshes += 1
         return snapshot
 

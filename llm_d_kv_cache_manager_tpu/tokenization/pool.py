@@ -119,6 +119,7 @@ class TokenizationPool:
         self._started = False  # guarded-by: _lock
 
     def set_tokenizer(self, tokenizer: Tokenizer, model_name: str) -> None:
+        # gil-atomic: wiring-time single ref store before start()
         self._tokenizer = tokenizer
         self.config.model_name = model_name
 

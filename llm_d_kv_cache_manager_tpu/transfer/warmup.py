@@ -258,6 +258,7 @@ class WarmupWorker:
                 moved += 1
                 METRICS.transfer_warmup_moves.inc()
             METRICS.transfer_cold_pods.set(cold)
+        # gil-atomic: stats counter bumped by the one warm-up thread
         self._cycles += 1
         return moved
 
@@ -266,6 +267,7 @@ class WarmupWorker:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._thread = threading.Thread(
             target=self._run,
             name="kvtpu-transfer-warmup",
@@ -285,6 +287,7 @@ class WarmupWorker:
         thread = self._thread
         if thread is not None:
             thread.join(timeout=5.0)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._thread = None
 
     def status(self) -> dict:

@@ -56,6 +56,7 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 __all__ = [
+    "GuardRecordingLock",
     "LockOrderViolation",
     "contention_sample",
     "contention_stats",
@@ -63,10 +64,16 @@ __all__ = [
     "declare_order",
     "enable",
     "enabled",
+    "guard_recording",
     "held",
+    "holder_of",
+    "holds",
+    "raw_lock",
     "reset_contention_stats",
     "reset_declarations",
     "set_contention_sample",
+    "set_fuzz_hook",
+    "set_guard_recording",
     "tracked",
 ]
 
@@ -166,6 +173,149 @@ def _check(
             )
 
 
+# ------------------------ held-lock registry ---------------------------
+#
+# The raceguard plane (utils/raceguard.py, KVTPU_RACEGUARD=1) needs one
+# question answered on every guarded attribute access: "does the
+# CURRENT thread hold this specific lock instance?"  The watchdog's
+# per-thread stack answers by *name*; guarded-by enforcement needs
+# *instance* identity, so this registry tracks raw-lock ids — fed by
+# every wrapper (TrackedLock, ContentionTimedLock, GuardRecordingLock)
+# when recording is armed, so raceguard composes with whichever mode a
+# storm runs under.  Off (the default) it is a single module-global
+# bool test on wrapper acquires and nothing at all on raw locks.
+
+_guard_recording = False
+
+# The preemption fuzzer's injection point (hack/racefuzz.py): called as
+# ``hook(kind, name)`` at every recording-lock acquire and — via
+# raceguard's descriptors — at every guarded read/write boundary.
+# Lives here (not in raceguard) so wrappers need no circular import.
+_fuzz_hook = None
+
+# raw-lock id -> ident of the thread currently holding it; plain dict
+# with single-key ops so the registry itself cannot deadlock anything.
+_holder_by_lock: Dict[int, int] = {}
+
+
+def guard_recording() -> bool:
+    return _guard_recording
+
+
+def set_guard_recording(flag: bool) -> bool:
+    """Arm/disarm held-lock recording (raceguard, racefuzz, tests);
+    returns the previous state."""
+    global _guard_recording
+    previous = _guard_recording
+    _guard_recording = flag
+    return previous
+
+
+def set_fuzz_hook(hook):
+    """Install the preemption-fuzz yield hook; returns the previous
+    one.  ``hook(kind, name)`` fires at guarded-access and
+    lock-acquire boundaries while recording is armed."""
+    global _fuzz_hook
+    previous = _fuzz_hook
+    _fuzz_hook = hook
+    return previous
+
+
+def raw_lock(lock):
+    """Unwrap OUR proxy layers only — never foreign internals (a
+    ``Condition`` owns a ``_lock`` attribute that must stay inside
+    it), so wrapper and checker agree on one lock identity."""
+    while isinstance(
+        lock, (TrackedLock, ContentionTimedLock, GuardRecordingLock)
+    ):
+        lock = lock._lock
+    return lock
+
+
+def _record_acquire(raw) -> None:
+    stack = getattr(_state, "guard_held", None)
+    if stack is None:
+        stack = _state.guard_held = []
+    stack.append(id(raw))
+    # gil-atomic: single-key put; one holder per lock at a time
+    _holder_by_lock[id(raw)] = threading.get_ident()
+
+
+def _record_release(raw) -> None:
+    stack = getattr(_state, "guard_held", None)
+    ident = id(raw)
+    if stack:
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == ident:
+                del stack[i]
+                break
+        if ident not in stack:
+            # Delete only our own entry: recording runs before the
+            # actual release, so no racer can have re-claimed it yet.
+            if _holder_by_lock.get(ident) == threading.get_ident():
+                # gil-atomic: single-key del by the current holder
+                _holder_by_lock.pop(ident, None)
+
+
+def holds(lock) -> bool:
+    """True when the CURRENT thread holds ``lock`` (any wrapping)."""
+    stack = getattr(_state, "guard_held", None)
+    return bool(stack) and id(raw_lock(lock)) in stack
+
+
+def holder_of(lock):
+    """Thread ident of the current holder, or None — the raceguard
+    violation report uses it to attach the *other* thread's stack."""
+    return _holder_by_lock.get(id(raw_lock(lock)))
+
+
+class GuardRecordingLock:
+    """Minimal held-lock-recording proxy for raw locks.
+
+    When raceguard arms on a tree where neither the watchdog nor
+    contention timing wrapped a class's lock (``tracked`` returned the
+    raw primitive), instances get this wrapper at ``__init__`` time so
+    their acquires still feed the registry — and the fuzz hook, which
+    fires BEFORE the inner acquire: that gap between two acquisitions
+    of a check-then-act is exactly where a seeded yield flushes the
+    race out.
+    """
+
+    __slots__ = ("_lock", "_name")
+
+    def __init__(self, lock, name: str = "") -> None:
+        self._lock = lock
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, *args, **kwargs):
+        hook = _fuzz_hook
+        if hook is not None:
+            hook("lock-acquire", self._name)
+        acquired = self._lock.acquire(*args, **kwargs)
+        if acquired and _guard_recording:
+            _record_acquire(self._lock)
+        return acquired
+
+    def release(self) -> None:
+        if _guard_recording:
+            _record_release(self._lock)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.release()
+
+    def __getattr__(self, attr):
+        return getattr(self._lock, attr)
+
+
 class TrackedLock:
     """Order-asserting proxy over a ``threading`` lock primitive.
 
@@ -192,15 +342,22 @@ class TrackedLock:
 
     def acquire(self, *args, **kwargs):
         _check(self._name, self._rank, id(self), self._reentrant)
+        hook = _fuzz_hook
+        if hook is not None:
+            hook("lock-acquire", self._name)
         acquired = self._lock.acquire(*args, **kwargs)
         if acquired:
             stack = getattr(_state, "stack", None)
             if stack is None:
                 stack = _state.stack = []
             stack.append((self._name, self._rank, id(self)))
+            if _guard_recording:
+                _record_acquire(self._lock)
         return acquired
 
     def release(self) -> None:
+        if _guard_recording:
+            _record_release(self._lock)
         self._lock.release()
         stack = getattr(_state, "stack", [])
         # Remove the innermost matching hold (locks release LIFO in
@@ -390,12 +547,20 @@ class ContentionTimedLock:
         return self._stat.name
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        # gil-atomic: per-instance sampling tick; a lost ++ only
+        # shifts which acquire gets probed
         self._tick += 1
         if self._tick % self._sample:
-            return self._lock.acquire(blocking, timeout)
+            acquired = self._lock.acquire(blocking, timeout)
+            if acquired and _guard_recording:
+                _record_acquire(self._lock)
+            return acquired
         stat = self._stat
-        stat.sampled += 1  # lock-free statistic (see _ContentionStat)
+        # gil-atomic: lock-free statistic (see _ContentionStat)
+        stat.sampled += 1
         if self._lock.acquire(False):
+            if _guard_recording:
+                _record_acquire(self._lock)
             return True
         if not blocking:
             # The probe WAS the caller's non-blocking attempt; a
@@ -405,9 +570,13 @@ class ContentionTimedLock:
         start = time.perf_counter()
         acquired = self._lock.acquire(blocking, timeout)
         stat.record_contended(time.perf_counter() - start)
+        if acquired and _guard_recording:
+            _record_acquire(self._lock)
         return acquired
 
     def release(self) -> None:
+        if _guard_recording:
+            _record_release(self._lock)
         self._lock.release()
 
     def __enter__(self):

@@ -130,6 +130,7 @@ class TTLCache(Generic[K, V]):
             while not self._stop.wait(interval):
                 self.sweep()
 
+        # gil-atomic: lifecycle ref; start/close are control-plane
         self._sweeper = threading.Thread(
             target=loop, name="kvtpu-ttl-sweeper", daemon=True
         )
@@ -139,6 +140,7 @@ class TTLCache(Generic[K, V]):
         self._stop.set()
         if self._sweeper is not None:
             self._sweeper.join(timeout=5)
+            # gil-atomic: lifecycle ref; start/close are control-plane
             self._sweeper = None
         self._stop.clear()
 

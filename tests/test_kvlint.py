@@ -7,13 +7,12 @@ CLI contract (``path:line: RULE: message``, exit 0/1) is pinned so
 must be clean — the same invocation CI runs.
 """
 
+import json
 import os
 import re
 import subprocess
 import sys
 import textwrap
-
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -1454,3 +1453,390 @@ class TestBaselineRulesScoping:
         # And the combined baseline still grandfathers everything.
         proc = run_cli("--baseline", str(baseline), str(bad))
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+class TestKV009Atomicity:
+    """Check-then-act: a guarded read in one acquisition feeding a
+    write in a *separate* acquisition of the same lock."""
+
+    BUGGY = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0  # guarded-by: _lock
+
+            def bump(self):
+                with self._lock:
+                    current = self._count
+                with self._lock:
+                    self._count = current + 1
+    """
+
+    def test_split_acquisition_flagged(self, tmp_path):
+        findings = lint(tmp_path, self.BUGGY, rules=["KV009"])
+        assert rule_ids(findings) == ["KV009"]
+        assert "_count" in findings[0].message
+        assert "separate acquisition" in findings[0].message
+
+    def test_merged_critical_section_passes(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0  # guarded-by: _lock
+
+                def bump(self):
+                    with self._lock:
+                        current = self._count
+                        self._count = current + 1
+            """,
+            rules=["KV009"],
+        )
+        assert findings == []
+
+    def test_atomic_ok_mark_suppresses(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0  # guarded-by: _lock
+
+                def bump(self):
+                    with self._lock:
+                        current = self._count
+                    with self._lock:
+                        # re-decided under the lock
+                        self._count = current + 1  # kvlint: atomic-ok
+            """,
+            rules=["KV009"],
+        )
+        assert findings == []
+
+    def test_different_locks_not_flagged(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class Split:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+                    self._a = 0  # guarded-by: _a_lock
+                    self._b = 0  # guarded-by: _b_lock
+
+                def move(self):
+                    with self._a_lock:
+                        value = self._a
+                    with self._b_lock:
+                        self._b = value
+            """,
+            rules=["KV009"],
+        )
+        assert findings == []
+
+    def test_reentrant_nesting_is_one_acquisition(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class Reentrant:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._count = 0  # guarded-by: _lock
+
+                def bump(self):
+                    with self._lock:
+                        current = self._count
+                        with self._lock:
+                            self._count = current + 1
+            """,
+            rules=["KV009"],
+        )
+        assert findings == []
+
+    def test_mutator_call_counts_as_write(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class Queue:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: _lock
+
+                def requeue(self):
+                    with self._lock:
+                        head = self._items[0]
+                    with self._lock:
+                        self._items.append(head)
+            """,
+            rules=["KV009"],
+        )
+        assert rule_ids(findings) == ["KV009"]
+
+
+class TestKV010GilDependence:
+    """Unguarded mutation of shared state on a lock-owning class must
+    justify itself with `# gil-atomic: <why>`."""
+
+    BUGGY = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}  # guarded-by: _lock
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                if self._thread is None:
+                    return
+    """
+
+    def test_unguarded_shared_write_flagged(self, tmp_path):
+        findings = lint(tmp_path, self.BUGGY, rules=["KV010"])
+        assert rule_ids(findings) == ["KV010"]
+        assert "_thread" in findings[0].message
+        assert "gil-atomic" in findings[0].message
+
+    def test_gil_atomic_annotation_passes(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            self.BUGGY.replace(
+                "self._thread = threading.Thread(target=self._run)",
+                "self._thread = threading.Thread("
+                "target=self._run)  # gil-atomic: lifecycle ref",
+            ),
+            rules=["KV010"],
+        )
+        assert findings == []
+
+    def test_locked_write_passes(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._data = {}  # guarded-by: _lock
+                    self._thread = None
+
+                def start(self):
+                    with self._lock:
+                        self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    if self._thread is None:
+                        return
+            """,
+            rules=["KV010"],
+        )
+        assert findings == []
+
+    def test_lockless_class_exempt(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class PlainBox:
+                def __init__(self):
+                    self._value = None
+
+                def set(self, value):
+                    self._value = value
+
+                def get(self):
+                    return self._value
+            """,
+            rules=["KV010"],
+        )
+        assert findings == []
+
+    def test_unshared_attr_exempt(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class OneMethod:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._data = {}  # guarded-by: _lock
+                    self._scratch = 0
+
+                def work(self):
+                    self._scratch = 1
+            """,
+            rules=["KV010"],
+        )
+        assert findings == []
+
+    def test_sync_primitive_attr_exempt(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class Stoppable:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._data = {}  # guarded-by: _lock
+                    self._stop = threading.Event()
+
+                def stop(self):
+                    self._stop.set()
+
+                def reset(self):
+                    self._stop.clear()
+            """,
+            rules=["KV010"],
+        )
+        assert findings == []
+
+
+MANIFEST_FIXTURE = {
+    "cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}  # guarded-by: _lock
+
+            def get(self, key):
+                with self._lock:
+                    return self._data.get(key)
+
+            def _purge_locked(self):
+                self._data.clear()
+    """
+}
+
+
+class TestRaceguardManifest:
+    """--emit-manifest / --check-manifest: phase 1's guarded-by model
+    exported byte-deterministically and staleness-pinned."""
+
+    def test_emit_to_stdout_deterministic(self, tmp_path):
+        pkg = project(tmp_path, MANIFEST_FIXTURE)
+        first = run_cli("--emit-manifest", "-", str(pkg))
+        second = run_cli("--emit-manifest", "-", str(pkg))
+        assert first.returncode == 0, first.stderr
+        assert first.stdout == second.stdout
+        manifest = json.loads(first.stdout)
+        assert manifest["version"] == 1
+        (key, entry), = manifest["classes"].items()
+        assert key == "pkg.cache:Cache"
+        assert entry["guarded"] == {"_data": "_lock"}
+        assert entry["locks"] == ["_lock"]
+        assert entry["caller_locked"] == ["_purge_locked"]
+
+    def test_checked_in_manifest_matches_tree(self):
+        """The staleness pin CI relies on: the committed manifest is
+        regenerated from the committed annotations."""
+        proc = run_cli("llm_d_kv_cache_manager_tpu", "--check-manifest")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_annotation_change_without_regen_fails(self, tmp_path):
+        pkg = project(tmp_path, MANIFEST_FIXTURE)
+        proc = run_cli(str(pkg), "--emit-manifest")
+        assert proc.returncode == 0, proc.stderr
+        proc = run_cli("--check-manifest", str(pkg))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        # Re-annotate under a different lock without regenerating.
+        cache = pkg / "cache.py"
+        cache.write_text(
+            cache.read_text().replace(
+                "# guarded-by: _lock", "# guarded-by: _other_lock"
+            )
+        )
+        proc = run_cli("--check-manifest", str(pkg))
+        assert proc.returncode == 1
+        assert "stale" in proc.stderr
+        assert "pkg.cache:Cache" in proc.stderr
+
+    def test_gil_inventory_emitter(self, tmp_path):
+        pkg = project(
+            tmp_path,
+            {
+                "engine.py": """
+                    import threading
+
+                    class Engine:
+                        def __init__(self):
+                            self._lock = threading.Lock()
+                            self._data = {}  # guarded-by: _lock
+                            self._thread = None
+
+                        def start(self):
+                            self._thread = threading.Thread(
+                                target=self._run
+                            )  # gil-atomic: lifecycle ref
+
+                        def _run(self):
+                            if self._thread is None:
+                                return
+                """
+            },
+        )
+        proc = run_cli("--emit-gil-inventory", "-", str(pkg))
+        assert proc.returncode == 0, proc.stderr
+        inventory = json.loads(proc.stdout)
+        assert inventory["version"] == 1
+        (site,) = inventory["sites"]
+        assert site["class"] == "Engine"
+        assert site["attr"] == "_thread"
+        assert site["why"] == "lifecycle ref"
+
+
+class TestParallelParse:
+    """--jobs N: parallel parsing must be byte-identical to
+    sequential, findings in the same order."""
+
+    def test_jobs_output_identical(self, tmp_path):
+        files = {}
+        for index in range(6):
+            files[f"mod_{index}.py"] = f"""
+                import threading
+
+                class C{index}:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._data = {{}}  # guarded-by: _lock
+
+                    def peek(self):
+                        return self._data.get("x")
+
+                def f{index}():
+                    try:
+                        pass
+                    except:
+                        pass
+            """
+        pkg = project(tmp_path, files)
+        sequential = run_cli("--no-baseline", str(pkg))
+        parallel = run_cli("--no-baseline", "--jobs", "4", str(pkg))
+        assert sequential.returncode == 1
+        assert parallel.returncode == 1
+        assert sequential.stdout == parallel.stdout
+        assert sequential.stdout.count("KV001") == 6
+        assert sequential.stdout.count("KV005") == 6

@@ -1,0 +1,289 @@
+"""Guarded-by runtime enforcement (utils/raceguard.py) + the
+preemption fuzzer (hack/racefuzz.py).
+
+The planted-defect gauntlet: raceguard must flag a planted guarded-by
+violation at runtime, the runtime check must catch a caller-locked
+claim that kvlint phase 1 trusted statically, and
+``python -m hack.racefuzz --seed N`` must deterministically reproduce
+a planted check-then-act race.  The inverse contract matters just as
+much: with ``KVTPU_RACEGUARD`` unset nothing is instrumented and
+attribute access stays native.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from llm_d_kv_cache_manager_tpu.utils import lockorder  # noqa: E402
+from llm_d_kv_cache_manager_tpu.utils import raceguard  # noqa: E402
+
+
+@pytest.fixture
+def armed():
+    """Recording on for the test, everything restored after."""
+    previous = lockorder.set_guard_recording(True)
+    try:
+        yield
+    finally:
+        raceguard.uninstall()
+        lockorder.set_guard_recording(previous)
+        lockorder.set_fuzz_hook(None)
+
+
+def make_cache():
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._data = {}  # guarded-by: _lock
+
+        def put(self, key, value):
+            with self._lock:
+                self._data[key] = value
+
+        def get(self, key):
+            with self._lock:
+                return self._data.get(key)
+
+        def bad_put(self, key, value):
+            self._data[key] = value  # planted: no lock
+
+    return Cache
+
+
+class TestGuardedAttribute:
+    def test_locked_access_passes_and_round_trips(self, armed):
+        Cache = raceguard.guard_class(make_cache(), {"_data": "_lock"})
+        cache = Cache()
+        cache.put("a", 1)
+        assert cache.get("a") == 1
+
+    def test_planted_unguarded_write_caught(self, armed):
+        Cache = raceguard.guard_class(make_cache(), {"_data": "_lock"})
+        cache = Cache()
+        with pytest.raises(raceguard.RaceGuardViolation) as excinfo:
+            cache.bad_put("a", 1)
+        message = str(excinfo.value)
+        assert "Cache._data" in message
+        assert "_lock" in message
+
+    def test_planted_unguarded_read_caught(self, armed):
+        Cache = raceguard.guard_class(make_cache(), {"_data": "_lock"})
+        cache = Cache()
+        with pytest.raises(raceguard.RaceGuardViolation):
+            cache._data
+
+    def test_caller_locked_false_claim_caught(self, armed):
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+
+            def _push_locked(self, item):  # kvlint: caller-locked
+                self._items.append(item)
+
+            def honest(self, item):
+                with self._lock:
+                    self._push_locked(item)
+
+            def lying(self, item):
+                # kvlint phase 1 trusts the claim; runtime must not.
+                self._push_locked(item)
+
+        raceguard.guard_class(Ledger, {"_items": "_lock"})
+        ledger = Ledger()
+        ledger.honest(1)
+        with pytest.raises(raceguard.RaceGuardViolation):
+            ledger.lying(2)
+
+    def test_violation_reports_both_thread_stacks(self, armed):
+        Cache = raceguard.guard_class(make_cache(), {"_data": "_lock"})
+        cache = Cache()
+        holder_in = threading.Event()
+        release = threading.Event()
+
+        def hold_forever():
+            with cache._lock:
+                holder_in.set()
+                release.wait(5.0)
+
+        holder = threading.Thread(target=hold_forever, name="holder")
+        holder.start()
+        try:
+            assert holder_in.wait(5.0)
+            with pytest.raises(raceguard.RaceGuardViolation) as excinfo:
+                cache._data
+            message = str(excinfo.value)
+            assert "accessing thread" in message
+            assert "holder" in message  # the other stack, by name
+            assert "hold_forever" in message
+        finally:
+            release.set()
+            holder.join()
+
+    def test_works_with_slots(self, armed):
+        class Slotted:
+            __slots__ = ("_lock", "_value")
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0  # guarded-by: _lock
+
+            def bump(self):
+                with self._lock:
+                    self._value += 1
+
+        raceguard.guard_class(Slotted, {"_value": "_lock"})
+        obj = Slotted()
+        obj.bump()
+        with obj._lock:
+            assert obj._value == 1
+        with pytest.raises(raceguard.RaceGuardViolation):
+            obj._value
+
+    def test_uninstall_restores_raw_access(self, armed):
+        Cache = raceguard.guard_class(make_cache(), {"_data": "_lock"})
+        assert isinstance(
+            Cache.__dict__["_data"], raceguard.GuardedAttribute
+        )
+        raceguard.uninstall()
+        assert "_data" not in Cache.__dict__
+        cache = Cache()
+        cache._data["a"] = 1  # lockless: fine again
+        assert cache._data == {"a": 1}
+
+    def test_composes_with_watchdog_wrapper(self, armed):
+        """A TrackedLock (watchdog) feeds the same held-lock registry,
+        so raceguard accepts it without double wrapping."""
+        class Tracked:
+            def __init__(self):
+                self._lock = lockorder.TrackedLock(
+                    threading.Lock(), "test.Tracked._lock", None
+                )
+                self._value = 0  # guarded-by: _lock
+
+            def bump(self):
+                with self._lock:
+                    self._value += 1
+
+        raceguard.guard_class(Tracked, {"_value": "_lock"})
+        obj = Tracked()
+        assert isinstance(obj._lock, lockorder.TrackedLock)  # untouched
+        obj.bump()
+        with pytest.raises(raceguard.RaceGuardViolation):
+            obj._value
+
+
+class TestZeroCostUnarmed:
+    """KVTPU_RACEGUARD unset: raw attribute access, nothing installed."""
+
+    pytestmark = pytest.mark.skipif(
+        raceguard.armed_from_env(),
+        reason="suite running with KVTPU_RACEGUARD armed",
+    )
+
+    def test_nothing_installed_by_default(self):
+        assert not raceguard.installed()
+
+    def test_manifest_class_keeps_raw_attributes(self):
+        from llm_d_kv_cache_manager_tpu.utils.ttl_cache import TTLCache
+
+        assert "_entries" not in TTLCache.__dict__
+        assert not getattr(
+            TTLCache.__init__, "__raceguard_wrapped__", False
+        )
+        cache = TTLCache(ttl_seconds=5.0)
+        # Lockless access must be plain (no descriptor, no raise).
+        assert cache._entries == {}
+        # And the lock stays a raw primitive — no recording wrapper.
+        assert not isinstance(
+            cache._lock, lockorder.GuardRecordingLock
+        )
+
+
+class TestManifestInstall:
+    def test_install_uninstall_roundtrip(self, armed, tmp_path):
+        """Install from a manifest naming a real class, verify the
+        descriptor is live, uninstall, verify raw access returns."""
+        manifest = {
+            "version": 1,
+            "classes": {
+                "llm_d_kv_cache_manager_tpu.utils.ttl_cache:TTLCache": {
+                    "guarded": {"_entries": "_lock"},
+                    "locks": ["_lock", "_cb_lock"],
+                    "caller_locked": [],
+                }
+            },
+        }
+        path = tmp_path / "manifest.json"
+        path.write_text(json.dumps(manifest))
+        from llm_d_kv_cache_manager_tpu.utils.ttl_cache import TTLCache
+
+        count = raceguard.install(str(path))
+        assert count == 1
+        assert isinstance(
+            TTLCache.__dict__["_entries"], raceguard.GuardedAttribute
+        )
+        cache = TTLCache(ttl_seconds=5.0)
+        assert isinstance(cache._lock, lockorder.GuardRecordingLock)
+        cache.set("k", "v")
+        assert cache.get("k") == "v"
+        with pytest.raises(raceguard.RaceGuardViolation):
+            cache._entries
+        raceguard.uninstall()
+        assert "_entries" not in TTLCache.__dict__
+        fresh = TTLCache(ttl_seconds=5.0)
+        assert fresh._entries == {}
+
+    def test_checked_in_manifest_loads_and_names_real_classes(self):
+        manifest = raceguard.load_manifest()
+        assert manifest["version"] == 1
+        classes = manifest["classes"]
+        assert len(classes) >= 30
+        key = "llm_d_kv_cache_manager_tpu.utils.ttl_cache:TTLCache"
+        assert key in classes
+        assert classes[key]["guarded"] == {"_entries": "_lock"}
+
+
+def run_racefuzz(*args):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "hack.racefuzz", *args],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+
+
+class TestRaceFuzz:
+    def test_pinned_seed_reproduces_check_then_act(self):
+        """The acceptance gauntlet's fuzzer leg: a pinned seed must
+        deterministically reproduce the planted check-then-act race
+        and report both thread stacks."""
+        proc = run_racefuzz("--plant", "check-then-act", "--seed", "1337")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "REPRODUCED" in proc.stdout
+        assert "lost update" in proc.stdout
+        assert proc.stdout.count("thread ") >= 2  # both stacks
+        assert "buggy_increment" in proc.stdout
+
+    def test_planted_guarded_write_flagged(self):
+        proc = run_racefuzz("--plant", "guarded-write", "--seed", "1")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "REPRODUCED" in proc.stdout
+        assert "PlantedGuardedWrite._value" in proc.stdout
+
+    def test_planted_caller_locked_lie_flagged(self):
+        proc = run_racefuzz("--plant", "caller-locked", "--seed", "1")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "REPRODUCED" in proc.stdout
+        assert "PlantedCallerLocked._items" in proc.stdout
