@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all lint kvlint racefuzz-smoke lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke perf-trend profile-smoke events-smoke cachestats-smoke tiering-smoke transfer-smoke cluster-smoke offload-smoke replay-smoke bench native native-race proto graft-check chart clean
+.PHONY: all lint kvlint racefuzz-smoke lockorder-smoke test unit-test e2e-test examples obs-smoke slo-smoke perf-smoke perf-trend profile-smoke events-smoke cachestats-smoke tiering-smoke transfer-smoke cluster-smoke offload-smoke replay-smoke whatif-smoke bench native native-race proto graft-check chart clean
 
 all: native test
 
@@ -99,6 +99,18 @@ slo-smoke:
 # (docs/observability.md "Incident response runbook").
 replay-smoke:
 	$(CPU_ENV) $(PYTHON) hack/replay_smoke.py
+
+# What-if engine smoke (same invocation as CI's "What-if smoke"
+# step): composes a 4x pod-fanout storm from the pinned reference
+# capture, proves the shards=1 vs shards=8 A/B deterministically
+# agrees (and a flow-control-starved arm measurably sheds with a
+# first SLO-divergence point), exercises GET /debug/whatif,
+# GET /debug/incidents/<id> and POST /admin/whatif against a live
+# bundle, and verifies the perf-trend capacity gate passes honestly
+# and fails a planted regression (docs/observability.md "What-if
+# engine").
+whatif-smoke:
+	$(CPU_ENV) $(PYTHON) hack/whatif_smoke.py
 
 # Read-path perf smoke (same invocation as CI's "Read-path perf
 # smoke" step): a few seconds of the bench's read_path regime on CPU,

@@ -23,7 +23,19 @@ the previous bench pinned and no gate would notice.  This script:
   device count, and any numeric throughput fields the dryrun grows —
   so the offload-lanes trajectory is visible in ``make perf-trend``
   without gating on it (the dryrun is a compile check, not a perf
-  measurement).
+  measurement);
+* gates the what-if capacity trajectory (``WHATIF_r<NN>.json``,
+  written by ``hack/whatif_smoke.py``): the table/threshold treatment
+  above, PLUS a LIVE check — when the trajectory is non-empty and the
+  pinned reference capture is present, it replays the shards=1 vs
+  shards=8 A/B (``obs/whatif.reference_ab``) in-process and fails if
+  any deterministic headline (hit rate, recorded-score parity, A/B
+  hit parity) fell more than ``--threshold`` below the newest
+  artifact.  Unlike the bench numbers these are machine-independent,
+  so the live check catches a capacity regression in the PR ITSELF,
+  not just between recorded runs.  ``--skip-whatif`` disables the
+  live replay (table still shown); ``--reference`` points at a
+  different capture.
 
 Regimes rotate between runs, so a headline absent from the newest
 artifact is simply not compared — only measured regressions fail.
@@ -47,6 +59,16 @@ DEFAULT_THRESHOLD = 0.10
 
 _ARTIFACT_RE = re.compile(r"BENCH_r(\d+)\.json$")
 _MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
+_WHATIF_RE = re.compile(r"WHATIF_r(\d+)\.json$")
+
+# Default reference capture for the live what-if check (relative to
+# the repo root this script lives under).
+_WHATIF_REFERENCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests",
+    "testdata",
+    "whatif_reference.cbor",
+)
 
 # Headline keys gated by the regression check.  All are
 # higher-is-better by construction (throughputs, speedups,
@@ -291,6 +313,153 @@ def multichip_lines(
     return lines
 
 
+def extract_whatif(artifact: dict) -> Dict[str, float]:
+    """Gated headline values from one WHATIF artifact (the
+    ``headlines`` dict ``hack/whatif_smoke.py`` stores — the
+    ``obs/whatif.gate_headlines`` output)."""
+    if artifact.get("rc", 0) not in (0, None):
+        return {}
+    headlines = artifact.get("headlines")
+    if not isinstance(headlines, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for key, raw in headlines.items():
+        value = _num(raw)
+        if isinstance(key, str) and value is not None and value > 0:
+            out[key] = value
+    return out
+
+
+def load_whatif_trajectory(
+    directory: str,
+) -> List[Tuple[int, str, Dict[str, float]]]:
+    """[(run number, filename, headlines)] sorted oldest first."""
+    runs: List[Tuple[int, str, Dict[str, float]]] = []
+    for path in glob.glob(os.path.join(directory, "WHATIF_r*.json")):
+        match = _WHATIF_RE.search(os.path.basename(path))
+        if not match:
+            continue
+        try:
+            with open(path) as handle:
+                artifact = json.load(handle)
+        except (OSError, ValueError) as exc:
+            print(f"perf-trend: skipping unreadable {path}: {exc}")
+            continue
+        if not isinstance(artifact, dict):
+            print(f"perf-trend: skipping non-object {path}")
+            continue
+        runs.append(
+            (
+                int(match.group(1)),
+                os.path.basename(path),
+                extract_whatif(artifact),
+            )
+        )
+    runs.sort(key=lambda item: item[0])
+    return runs
+
+
+def whatif_evaluate(
+    runs: List[Tuple[int, str, Dict[str, float]]],
+    threshold: float,
+    reference: str,
+    skip_live: bool,
+) -> Tuple[List[str], List[str]]:
+    """(table lines, regression messages) for the what-if capacity
+    trajectory, including the live reference A/B when available.
+    Every ``whatif.*`` headline is higher-is-better and gated — they
+    are deterministic measurements of the pinned capture, so any drop
+    past the threshold is a real capacity/behavior change, never
+    machine noise."""
+    lines: List[str] = []
+    regressions: List[str] = []
+    if not runs:
+        return [], []
+    newest_n, newest_name, newest = runs[-1]
+    keys = sorted({key for _, _, headlines in runs for key in headlines})
+    lines.append(
+        f"perf-trend: what-if trajectory ({len(runs)} artifacts, "
+        f"newest {newest_name}; deterministic headlines, all gated)"
+    )
+    for key in keys:
+        row = [key.ljust(30)]
+        prior: Optional[float] = None
+        for n, _, headlines in runs:
+            value = headlines.get(key)
+            row.append(
+                f"{value:10.4f}" if value is not None else " " * 9 + "—"
+            )
+            if n != newest_n and value is not None:
+                prior = value
+        verdict = ""
+        current = newest.get(key)
+        if current is not None and prior is not None and prior > 0:
+            delta = (current - prior) / prior
+            verdict = f"{delta:+.1%}"
+            if delta < -threshold:
+                verdict += "  REGRESSED"
+                regressions.append(
+                    f"{key}: {current:.4f} vs prior {prior:.4f} "
+                    f"({delta:+.1%} < -{threshold:.0%})"
+                )
+        elif current is not None:
+            verdict = "(no prior)"
+        lines.append("  ".join(row) + f"   {verdict}")
+
+    if skip_live:
+        lines.append("perf-trend: live what-if check skipped (--skip-whatif)")
+        return lines, regressions
+    if not os.path.isfile(reference):
+        lines.append(
+            f"perf-trend: live what-if check skipped (no reference "
+            f"capture at {reference})"
+        )
+        return lines, regressions
+    live, error = _live_whatif_headlines(reference)
+    if live is None:
+        lines.append(
+            f"perf-trend: live what-if check unavailable: {error}"
+        )
+        return lines, regressions
+    lines.append(
+        "perf-trend: live reference A/B (shards=1 vs shards=8) vs "
+        f"{newest_name}:"
+    )
+    for key in sorted(live):
+        current = live[key]
+        baseline = newest.get(key)
+        verdict = "(no baseline)"
+        if baseline is not None and baseline > 0:
+            delta = (current - baseline) / baseline
+            verdict = f"baseline {baseline:.4f}  {delta:+.1%}"
+            if delta < -threshold:
+                verdict += "  REGRESSED"
+                regressions.append(
+                    f"{key} (live): {current:.4f} vs recorded "
+                    f"{baseline:.4f} ({delta:+.1%} < -{threshold:.0%})"
+                )
+        lines.append(f"  {key.ljust(28)} live {current:10.4f}   {verdict}")
+    return lines, regressions
+
+
+def _live_whatif_headlines(
+    reference: str,
+) -> Tuple[Optional[Dict[str, float]], Optional[str]]:
+    """Run the reference A/B in-process; (headlines, None) on success,
+    (None, reason) when the stack cannot run here."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    )
+    try:
+        from llm_d_kv_cache_manager_tpu.obs import whatif as whatif_mod
+
+        ab = whatif_mod.reference_ab(reference)
+        return whatif_mod.gate_headlines(ab), None
+    except Exception as exc:  # noqa: BLE001 — report, don't crash the gate
+        return None, f"{type(exc).__name__}: {exc}"
+
+
 def evaluate(
     runs: List[Tuple[int, str, Dict[str, float]]],
     threshold: float,
@@ -360,6 +529,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         default=DEFAULT_THRESHOLD,
         help="fractional regression that fails the gate (default 0.10)",
     )
+    parser.add_argument(
+        "--skip-whatif",
+        action="store_true",
+        help="skip the live reference what-if A/B (trajectory table "
+        "still shown and gated)",
+    )
+    parser.add_argument(
+        "--reference",
+        default=_WHATIF_REFERENCE,
+        help="reference capture for the live what-if check (default: "
+        "tests/testdata/whatif_reference.cbor)",
+    )
     args = parser.parse_args(argv)
     runs = load_trajectory(args.dir)
     lines, regressions = evaluate(runs, args.threshold)
@@ -367,6 +548,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(line)
     for line in multichip_lines(load_multichip_trajectory(args.dir)):
         print(line)
+    whatif_lines, whatif_regressions = whatif_evaluate(
+        load_whatif_trajectory(args.dir),
+        args.threshold,
+        args.reference,
+        args.skip_whatif,
+    )
+    for line in whatif_lines:
+        print(line)
+    regressions.extend(whatif_regressions)
     if regressions:
         print(
             f"perf-trend: FAIL — {len(regressions)} headline(s) "

@@ -321,6 +321,12 @@ def _make_handler(
                 self._debug_timeline(query)
             elif path == "/debug/incidents":
                 self._debug_incidents()
+            elif path.startswith("/debug/incidents/"):
+                self._debug_incident_detail(
+                    path[len("/debug/incidents/"):]
+                )
+            elif path == "/debug/whatif":
+                self._debug_whatif(query)
             else:
                 self._error(404, "not found")
 
@@ -433,6 +439,18 @@ def _make_handler(
                         else None
                     ),
                 },
+                {
+                    "path": "/debug/whatif",
+                    # The engine is in-process library code with a
+                    # module-level results ring — always answerable.
+                    "enabled": True,
+                    "description": (
+                        "what-if engine verdicts: time-compressed "
+                        "replays and A/B config canaries, newest "
+                        "first (?full=1; POST /admin/whatif runs one "
+                        "against a capture or incident bundle)"
+                    ),
+                },
             ]
             self._reply_json(
                 200,
@@ -530,6 +548,47 @@ def _make_handler(
                 self._reply_json(200, payload)
             except Exception as exc:  # noqa: BLE001 — debug must answer
                 logger.exception("incident status failed")
+                self._error(500, f"error: {exc}")
+
+        def _debug_incident_detail(self, incident_id):
+            """One retained incident bundle's manifest + source-file
+            inventory (byte sizes) — what an operator pulls before
+            running the bundle through replay or the what-if engine
+            (docs/observability.md "Incident response runbook")."""
+            if incidents is None:
+                self._error(404, "capture disabled (CAPTURE=0)")
+                return
+            try:
+                detail = incidents.detail(incident_id)
+            except Exception as exc:  # noqa: BLE001 — debug must answer
+                logger.exception("incident detail failed")
+                self._error(500, f"error: {exc}")
+                return
+            if detail is None:
+                self._error(404, f"no such incident: {incident_id}")
+                return
+            self._reply_json(200, detail)
+
+        def _debug_whatif(self, query):
+            """Read-only what-if engine results ring: recent replay /
+            A/B verdicts, newest first (?full=1 for complete results;
+            POST /admin/whatif runs one; docs/observability.md
+            "What-if engine")."""
+            from llm_d_kv_cache_manager_tpu.obs import whatif as whatif_mod
+
+            try:
+                full = query.get("full", "").lower() in (
+                    "1",
+                    "true",
+                    "yes",
+                )
+                payload = whatif_mod.REGISTRY.status()
+                payload["results_list"] = whatif_mod.REGISTRY.list(
+                    full=full
+                )
+                self._reply_json(200, payload)
+            except Exception as exc:  # noqa: BLE001 — debug must answer
+                logger.exception("whatif status failed")
                 self._error(500, f"error: {exc}")
 
         def _debug_slo(self):
@@ -705,6 +764,8 @@ def _make_handler(
                     self._snapshot()
                 elif path == "/admin/incident":
                     self._incident()
+                elif path == "/admin/whatif":
+                    self._whatif()
                 elif path == "/replica":
                     self._replica_call()
                 else:
@@ -855,6 +916,90 @@ def _make_handler(
                 self._error(500, "incident bundle failed (see logs)")
                 return
             self._reply_json(200, manifest)
+
+        def _whatif(self):
+            """Operator what-if: replay a capture (or a retained
+            incident bundle, by id) through candidate config arms
+            IN-PROCESS and reply with the measured verdict.  Body:
+            ``{"bundle": "inc-..."}`` or ``{"capture": "<path>"}``,
+            plus optional ``"kind"`` ("run" | "ab", default "ab"),
+            ``"arm"`` / ``"a"`` / ``"b"`` arm specs
+            ("shards=8,mode=cluster"), and ``"speed"``.  Admin-gated:
+            it reads operator-named filesystem paths and burns CPU for
+            seconds.  The full result lands in the /debug/whatif ring;
+            the reply carries the summary (docs/observability.md
+            "What-if engine")."""
+            if not self._admin_allowed():
+                self._error(403, "admin endpoint: token or loopback only")
+                return
+            request = self._read_json() if self._declares_body() else {}
+            if request is None:
+                return
+            from llm_d_kv_cache_manager_tpu.obs import whatif as whatif_mod
+
+            source = None
+            bundle = request.get("bundle")
+            if bundle:
+                if incidents is None:
+                    self._error(503, "incident capture not configured")
+                    return
+                detail = incidents.detail(str(bundle))
+                if detail is None:
+                    self._error(404, f"no such incident: {bundle}")
+                    return
+                source = detail["directory"]
+            elif request.get("capture"):
+                source = str(request["capture"])
+            else:
+                self._error(400, "body needs 'bundle' or 'capture'")
+                return
+            try:
+                config = whatif_mod.WhatIfConfig.from_env()
+                if request.get("speed"):
+                    config.speed = float(request["speed"])
+                capture_doc = whatif_mod.load_capture(
+                    whatif_mod.resolve_capture_source(source),
+                    allow_mismatch=True,
+                )
+                kind = str(request.get("kind") or "ab")
+                if kind == "run":
+                    result = whatif_mod.run_whatif(
+                        capture_doc,
+                        whatif_mod.StackConfig.parse(
+                            str(request.get("arm") or ""), name="a"
+                        ),
+                        config,
+                    )
+                elif kind == "ab":
+                    result = whatif_mod.run_ab(
+                        capture_doc,
+                        whatif_mod.StackConfig.parse(
+                            str(request.get("a") or "shards=1"),
+                            name="a",
+                        ),
+                        whatif_mod.StackConfig.parse(
+                            str(request.get("b") or "shards=8"),
+                            name="b",
+                        ),
+                        config,
+                    )
+                else:
+                    self._error(400, f"unknown kind {kind!r}")
+                    return
+            except (ValueError, FileNotFoundError, OSError) as exc:
+                self._error(400, f"whatif failed: {exc}")
+                return
+            except Exception as exc:  # noqa: BLE001 — reply, don't wedge
+                logger.exception("admin whatif failed")
+                self._error(500, f"error: {exc}")
+                return
+            self._reply_json(
+                200,
+                {
+                    "source": source,
+                    "summary": whatif_mod._summarize(result),
+                },
+            )
 
         @staticmethod
         def _wants_explain(query) -> bool:

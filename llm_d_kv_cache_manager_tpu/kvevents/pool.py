@@ -482,24 +482,44 @@ class _ShardQueue:
                 self._lock.wait()
             if not self._lanes:
                 return [], True, {}
-            batch: List[Message] = []
-            depths: Dict[str, int] = {}
-            while self._lanes and len(batch) < limit:
-                key, lane = next(iter(self._lanes.items()))
-                message = lane.popleft()
-                batch.append(message)
-                if message.resync is None:
-                    depth = self._regular[key]
-                    self._regular[key] = depth - 1
-                    self._depth_move_locked(key, depth, depth - 1)
-                    self._size -= 1
-                depths[key] = self._regular.get(key, 0)
-                if lane:
-                    self._lanes.move_to_end(key)
-                else:
-                    del self._lanes[key]
-                    del self._regular[key]
+            batch, depths = self._drain_locked(limit)
             return batch, False, depths
+
+    def try_get_batch(
+        self, limit: int
+    ) -> Tuple[List[Message], Dict[str, int]]:
+        """Non-blocking :meth:`get_batch`: returns ``([], {})``
+        immediately when no lane holds work.  The deterministic inline
+        drain path (``Pool.process_inline``) uses it — a blocking wait
+        would deadlock a driver that IS the only producer."""
+        with self._lock:
+            if not self._lanes:
+                return [], {}
+            return self._drain_locked(limit)
+
+    def _drain_locked(
+        self, limit: int
+    ) -> Tuple[List[Message], Dict[str, int]]:
+        """Pop up to ``limit`` messages round-robin across lanes
+        (caller holds the lock and guarantees at least one lane)."""
+        batch: List[Message] = []
+        depths: Dict[str, int] = {}
+        while self._lanes and len(batch) < limit:
+            key, lane = next(iter(self._lanes.items()))
+            message = lane.popleft()
+            batch.append(message)
+            if message.resync is None:
+                depth = self._regular[key]
+                self._regular[key] = depth - 1
+                self._depth_move_locked(key, depth, depth - 1)
+                self._size -= 1
+            depths[key] = self._regular.get(key, 0)
+            if lane:
+                self._lanes.move_to_end(key)
+            else:
+                del self._lanes[key]
+                del self._regular[key]
+        return batch, depths
 
     def task_done(self, count: int) -> None:
         if count <= 0:
@@ -733,6 +753,9 @@ class Pool:
         ]
         self._threads: List[threading.Thread] = []  # guarded-by: _lock
         self._started = False  # guarded-by: _lock
+        # Digest memo for the inline (single-threaded) drain path;
+        # lazily built by process_inline, never shared with workers.
+        self._inline_memo: Optional[OrderedDict] = None
         self._lock = lockorder.tracked(threading.Lock(), "Pool._lock")
         self._lockfree_decode = self.config.resolved_lockfree_decode()
         self._digest_memo_size = self.config.resolved_digest_memo()
@@ -801,6 +824,58 @@ class Pool:
         """Block until every queued message has been processed (tests)."""
         for q in self._queues:
             q.join()
+
+    def process_inline(self, limit: int = 0) -> int:
+        """Synchronously decode + apply queued messages on the CALLING
+        thread — the deterministic drain primitive the what-if engine's
+        virtual clock schedules against (obs/whatif.py).
+
+        The pool must never have been ``start()``ed: with no workers,
+        ``add_tasks`` flow-control decisions are pure data-structure
+        ops and this call owns the only drain, so a given enqueue/drain
+        schedule processes messages in exactly one order.  Drains up to
+        ``limit`` messages (0 = everything currently queued), one
+        apply-batch per shard per rotation (shard order, then each
+        shard's own round-robin lanes).  Returns messages processed.
+        """
+        with self._lock:
+            if self._started:
+                raise RuntimeError(
+                    "process_inline requires an un-started pool "
+                    "(workers would race the inline drain)"
+                )
+        batch_limit = max(1, self.config.apply_batch_size)
+        memo = self._inline_memo
+        if memo is None and self._digest_memo_size:
+            memo = self._inline_memo = OrderedDict()
+        processed = 0
+        while True:
+            progressed = False
+            for q in self._queues:
+                take = batch_limit
+                if limit > 0:
+                    take = min(take, limit - processed)
+                    if take <= 0:
+                        return processed
+                batch, depths = q.try_get_batch(take)
+                if not batch:
+                    continue
+                for pod, depth in depths.items():
+                    if pod:
+                        self._backlog_gauge(pod).set(depth)
+                try:
+                    self._process_batch(batch, 0, memo)
+                except Exception:  # noqa: BLE001 — mirror the worker
+                    logger.exception(
+                        "inline drain failed processing a batch; "
+                        "dropping"
+                    )
+                finally:
+                    q.task_done(len(batch))
+                processed += len(batch)
+                progressed = True
+            if not progressed:
+                return processed
 
     @staticmethod
     def _finish_dropped(dropped: Message, reason: str) -> None:

@@ -550,6 +550,32 @@ class KVCacheMetrics:
             ("outcome",),
             registry=self.registry,
         )
+        # What-if engine (obs/whatif.py; docs/observability.md
+        # "What-if engine").
+        self.whatif_runs = Counter(
+            f"{_NAMESPACE}_whatif_runs_total",
+            "What-if replays completed, by kind (run / ab) and "
+            "outcome; CLI, /admin/whatif, and perf-trend gate runs "
+            "all count.",
+            ("kind", "outcome"),
+            registry=self.registry,
+        )
+        self.whatif_events = Counter(
+            f"{_NAMESPACE}_whatif_events_total",
+            "Recorded kvevents offered to what-if candidate stacks, "
+            "by the candidate's flow-control disposition (admitted / "
+            "shed).",
+            ("disposition",),
+            registry=self.registry,
+        )
+        self.whatif_hit_rate = Gauge(
+            f"{_NAMESPACE}_whatif_hit_rate",
+            "Hit rate measured by the most recent what-if replay, per "
+            "arm name (fraction of replayed scores with a non-zero "
+            "best score).",
+            ("arm",),
+            registry=self.registry,
+        )
         # Per-stage latencies fed by the tracing subsystem (obs/trace.py):
         # every span of a sampled trace lands here under its span name, so
         # the aggregate view and the per-request flight-recorder view

@@ -13,6 +13,7 @@ from llm_d_kv_cache_manager_tpu.obs.capture import (
     InputCaptureRecorder,
     capture_enabled_env,
     config_fingerprint,
+    encode_capture,
     fingerprint_status,
     set_build_info_metric,
 )
@@ -21,6 +22,21 @@ from llm_d_kv_cache_manager_tpu.obs.replay import (
     ReplayReport,
     load_capture,
     replay_capture,
+)
+from llm_d_kv_cache_manager_tpu.obs.whatif import (
+    StackConfig,
+    WhatIfConfig,
+    WhatIfRegistry,
+    capture_to_bytes,
+    gate_headlines,
+    interleave,
+    reference_ab,
+    repeat,
+    run_ab,
+    run_whatif,
+    scale_pods,
+    splice,
+    stretch,
 )
 from llm_d_kv_cache_manager_tpu.obs.profiler import (
     PROFILER,
@@ -37,6 +53,7 @@ from llm_d_kv_cache_manager_tpu.obs.slo import (
     SloEngine,
     SloSpec,
     default_fleet_slos,
+    envelope_states,
     envelope_violations,
 )
 from llm_d_kv_cache_manager_tpu.obs.trace import (
@@ -75,7 +92,22 @@ __all__ = [
     "SloEngine",
     "SloSpec",
     "default_fleet_slos",
+    "envelope_states",
     "envelope_violations",
+    "StackConfig",
+    "WhatIfConfig",
+    "WhatIfRegistry",
+    "capture_to_bytes",
+    "encode_capture",
+    "gate_headlines",
+    "interleave",
+    "reference_ab",
+    "repeat",
+    "run_ab",
+    "run_whatif",
+    "scale_pods",
+    "splice",
+    "stretch",
     "TRACER",
     "ParentContext",
     "Span",

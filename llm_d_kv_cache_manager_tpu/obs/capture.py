@@ -700,6 +700,49 @@ def load_artifact(data: bytes) -> dict:
     }
 
 
+def encode_capture(
+    records: Sequence[list],
+    fingerprint: Optional[str] = None,
+    knobs: Optional[Sequence[Sequence]] = None,
+    created_us: int = 0,
+    window_s: int = 0,
+    max_bytes: int = 0,
+    truncated: Optional[Sequence[str]] = None,
+    meta: Optional[Dict[str, object]] = None,
+    state: Optional[list] = None,
+) -> bytes:
+    """Serialize already-shaped records to a valid v1 capture artifact
+    — the writer for SYNTHETIC captures (the what-if engine's
+    composition operators and the pinned reference generator,
+    obs/whatif.py / hack/make_reference_capture.py).  ``records`` must
+    be fully expanded wire-shape rows (the forms ``load_artifact``
+    returns); ``knobs`` defaults to this process's resolved knob set
+    and ``fingerprint`` to its hash, so a synthetic artifact replays
+    under the same mismatch contract as a recorded one."""
+    if knobs is None:
+        knobs = fingerprint_knobs()
+    knobs = [[str(k), str(v)] for k, v in knobs]
+    if fingerprint is None:
+        fingerprint = config_fingerprint(
+            [(k, v) for k, v in knobs]
+        )
+    header = [
+        str(fingerprint),
+        knobs,
+        int(created_us),
+        int(window_s),
+        int(max_bytes),
+        [str(s) for s in (truncated or [])],
+        [
+            [str(key), str(value)]
+            for key, value in sorted((meta or {}).items())
+        ],
+    ]
+    return encode_canonical(
+        [CAPTURE_MAGIC, CAPTURE_VERSION, header, list(records), state]
+    )
+
+
 # ----------------------------- incident bundler ----------------------------
 
 DEFAULT_INCIDENT_KEEP = 8
@@ -905,6 +948,46 @@ class IncidentManager:
             except (OSError, ValueError) as exc:
                 out.append({"id": name, "error": f"unreadable: {exc}"})
         return out
+
+    def detail(self, incident_id: str) -> Optional[dict]:
+        """One bundle's manifest + on-disk source inventory (the
+        ``GET /debug/incidents/<id>`` payload): every file with its
+        byte size, so forensics knows what a bundle actually holds
+        before pulling multi-MB captures.  ``None`` for unknown or
+        malformed ids (path separators never traverse)."""
+        if (
+            not incident_id
+            or not incident_id.startswith("inc-")
+            or incident_id != os.path.basename(incident_id)
+        ):
+            return None
+        bundle_dir = os.path.join(self.directory, incident_id)
+        if not os.path.isdir(bundle_dir):
+            return None
+        manifest: dict
+        try:
+            with open(os.path.join(bundle_dir, "manifest.json")) as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError) as exc:
+            manifest = {"id": incident_id, "error": f"unreadable: {exc}"}
+        inventory = []
+        try:
+            names = sorted(os.listdir(bundle_dir))
+        except OSError:
+            names = []
+        for name in names:
+            path = os.path.join(bundle_dir, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1
+            inventory.append({"file": name, "bytes": size})
+        return {
+            "id": incident_id,
+            "directory": bundle_dir,
+            "manifest": manifest,
+            "inventory": inventory,
+        }
 
     def status(self) -> dict:
         bundles = self._bundle_dirs()

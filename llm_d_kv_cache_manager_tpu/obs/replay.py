@@ -72,14 +72,25 @@ class CaptureMismatchError(ValueError):
     process resolves — replaying would diverge for config reasons, not
     behavior reasons.  ``differences`` names each mismatched knob."""
 
-    def __init__(self, fingerprint: str, differences: List[str]) -> None:
+    def __init__(
+        self,
+        fingerprint: str,
+        differences: List[str],
+        source: Optional[str] = None,
+    ) -> None:
         self.fingerprint = fingerprint
         self.differences = differences
+        self.source = source
         detail = "; ".join(differences) or "package version differs"
+        # Forensics over a directory of bundles needs the offending
+        # artifact named IN the message (the short fingerprint is what
+        # `kvtpu_build_info` and manifests print).
+        artifact = f"{source} " if source else ""
         super().__init__(
-            f"capture fingerprint {fingerprint} does not match this "
-            f"process ({detail}); set the knobs to the recorded values "
-            "or pass allow_mismatch=True"
+            f"capture {artifact}(fingerprint {fingerprint[:8]}, full "
+            f"{fingerprint}) does not match this process ({detail}); "
+            "set the knobs to the recorded values or pass "
+            "allow_mismatch=True"
         )
 
 
@@ -93,7 +104,9 @@ def load_capture(
     """
     if isinstance(source, (bytes, bytearray, memoryview)):
         data = bytes(source)
+        source_name = None
     else:
+        source_name = str(source)
         with open(source, "rb") as handle:
             data = handle.read()
     capture = load_artifact(data)
@@ -103,10 +116,11 @@ def load_capture(
         differences = diff_knobs(capture["knobs"])
         if not allow_mismatch:
             raise CaptureMismatchError(
-                capture["fingerprint"], differences
+                capture["fingerprint"], differences, source_name
             )
         logger.warning(
-            "replaying a mismatched capture (%s): %s",
+            "replaying a mismatched capture %s (%s): %s",
+            source_name or "<bytes>",
             capture["fingerprint"],
             "; ".join(differences) or "version drift",
         )

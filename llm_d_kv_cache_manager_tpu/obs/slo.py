@@ -596,6 +596,17 @@ def envelope_violations(payload: dict) -> List[str]:
     return problems
 
 
+def envelope_states(payload: dict) -> Dict[str, str]:
+    """Compact ``{sli: state}`` map (plus ``"overall"``) from one
+    evaluation payload — the per-checkpoint shape the what-if engine's
+    A/B replay compares to find the first point of SLO divergence
+    (obs/whatif.py; docs/observability.md "What-if engine")."""
+    states = {"overall": str(payload.get("state", STATE_HEALTHY))}
+    for name, view in (payload.get("slis") or {}).items():
+        states[str(name)] = str(view.get("state", STATE_HEALTHY))
+    return states
+
+
 # ------------------------- source constructors -------------------------
 
 

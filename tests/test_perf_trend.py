@@ -375,3 +375,99 @@ class TestMultichipDisplay:
 
         (tmp_path / "MULTICHIP_r01.json").write_text("{nope")
         assert load_multichip_trajectory(str(tmp_path)) == []
+
+
+class TestWhatIfGate:
+    """ISSUE 18 tentpole: WHATIF_r*.json capacity trajectory + the
+    live reference A/B, gated like bench headlines."""
+
+    def _whatif(self, n, hit_rate=0.75, parity=1.0):
+        return {
+            "run": n,
+            "rc": 0,
+            "headlines": {
+                "whatif.hit_rate": hit_rate,
+                "whatif.recorded_parity": parity,
+                "whatif.ab_hit_parity": parity,
+            },
+        }
+
+    def test_extract_shapes(self):
+        from hack.perf_trend import extract_whatif
+
+        assert extract_whatif(self._whatif(1))["whatif.hit_rate"] == 0.75
+        assert extract_whatif({"rc": 1, "headlines": {"x": 1.0}}) == {}
+        assert extract_whatif({"rc": 0, "headlines": "nope"}) == {}
+        # Non-positive and non-numeric values never become baselines.
+        assert (
+            extract_whatif(
+                {"rc": 0, "headlines": {"a": 0.0, "b": "x", "c": 2.0}}
+            )
+            == {"c": 2.0}
+        )
+
+    def test_real_trajectory_parses(self):
+        from hack.perf_trend import load_whatif_trajectory
+
+        runs = load_whatif_trajectory(REPO_ROOT)
+        assert len(runs) >= 1
+        assert "whatif.hit_rate" in runs[-1][2]
+        assert runs[-1][2]["whatif.recorded_parity"] == 1.0
+
+    def test_trajectory_regression_fails(self, tmp_path):
+        _write(tmp_path, "WHATIF_r01.json", self._whatif(1, hit_rate=0.80))
+        _write(tmp_path, "WHATIF_r02.json", self._whatif(2, hit_rate=0.40))
+        assert (
+            main(["--dir", str(tmp_path), "--skip-whatif"]) == 1
+        )
+
+    def test_trajectory_within_threshold_passes(self, tmp_path):
+        _write(tmp_path, "WHATIF_r01.json", self._whatif(1, hit_rate=0.80))
+        _write(tmp_path, "WHATIF_r02.json", self._whatif(2, hit_rate=0.75))
+        assert (
+            main(["--dir", str(tmp_path), "--skip-whatif"]) == 0
+        )
+
+    def test_no_artifacts_means_no_whatif_gate(self, tmp_path):
+        from hack.perf_trend import whatif_evaluate
+
+        assert whatif_evaluate([], 0.10, "/nope", False) == ([], [])
+
+    def test_skip_live_still_gates_trajectory(self, tmp_path):
+        from hack.perf_trend import load_whatif_trajectory, whatif_evaluate
+
+        _write(tmp_path, "WHATIF_r01.json", self._whatif(1, hit_rate=0.80))
+        _write(tmp_path, "WHATIF_r02.json", self._whatif(2, hit_rate=0.40))
+        runs = load_whatif_trajectory(str(tmp_path))
+        lines, regressions = whatif_evaluate(runs, 0.10, "/nope", True)
+        assert any("--skip-whatif" in line for line in lines)
+        assert regressions and "whatif.hit_rate" in regressions[0]
+
+    def test_missing_reference_skips_live_cleanly(self, tmp_path):
+        from hack.perf_trend import load_whatif_trajectory, whatif_evaluate
+
+        _write(tmp_path, "WHATIF_r01.json", self._whatif(1))
+        runs = load_whatif_trajectory(str(tmp_path))
+        lines, regressions = whatif_evaluate(
+            runs, 0.10, str(tmp_path / "nope.cbor"), False
+        )
+        assert any("no reference capture" in line for line in lines)
+        assert regressions == []
+
+    def test_live_check_fails_inflated_baseline(self, tmp_path):
+        """A recorded baseline the live engine can no longer meet is
+        a capacity regression — the exact planted case the smoke
+        drives through the CLI, here in-process."""
+        from hack.perf_trend import load_whatif_trajectory, whatif_evaluate
+
+        reference = os.path.join(
+            REPO_ROOT, "tests", "testdata", "whatif_reference.cbor"
+        )
+        _write(tmp_path, "WHATIF_r01.json", self._whatif(1, hit_rate=0.99))
+        runs = load_whatif_trajectory(str(tmp_path))
+        lines, regressions = whatif_evaluate(runs, 0.10, reference, False)
+        assert any("live reference A/B" in line for line in lines)
+        assert any("whatif.hit_rate (live)" in r for r in regressions)
+        # The parity headlines match the planted artifact exactly, so
+        # only the inflated one regresses.
+        assert len(regressions) == 1
