@@ -51,7 +51,8 @@ racefuzz-smoke:
 	$(PYTHON) -m hack.racefuzz --plant check-then-act --seed 1337
 	$(PYTHON) -m hack.racefuzz --seed 1337 --time-budget 180 --storms \
 		tests/test_concurrency.py::TestBackendStorm \
-		tests/test_concurrency.py::TestShardedIndexStorm
+		tests/test_concurrency.py::TestShardedIndexStorm \
+		tests/test_concurrency.py::TestClusterFanoutStorm
 
 # Dynamic half of kvlint KV006 (same invocation as CI's "Lock-order
 # watchdog smoke" step): the concurrency storms plus the watchdog unit

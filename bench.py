@@ -372,6 +372,18 @@ def emit_result(full: dict, probe: dict) -> None:
             "trace_overhead": (
                 replica_scaleout.get("trace_ab") or {}
             ).get("overhead"),
+            # Pipelined read-path A/B (RTT-injected): 3-replica warm
+            # multi-turn scores/sec with overlap+pipelining armed, and
+            # its p99 as a multiple of the injected RTT.
+            "pipelined_sps": (
+                (replica_scaleout.get("pipelined_ab") or {}).get(
+                    "pipelined_warm"
+                )
+                or {}
+            ).get("scores_per_sec"),
+            "p99_rtt": (
+                replica_scaleout.get("pipelined_ab") or {}
+            ).get("p99_rtt_ratio"),
         }
     compact = {
         "metric": full["metric"],
@@ -2366,6 +2378,14 @@ def maybe_bench_read_path(context: str) -> dict:
 
 
 SCALEOUT_CELL_S = _env_float("KVTPU_BENCH_SCALEOUT_S", 1.0)
+# Synthetic per-RPC round-trip injected into the pipelined A/B cell's
+# transports: the in-process transport is so cheap that overlapping
+# it never pays (adaptive arming correctly stays sequential), so the
+# cell that prices the OVERLAP itself needs a realistic wire cost.
+# 2ms ~ cross-zone gRPC hop; large enough that the fixed per-request
+# tokenize/hash/score work doesn't drown the RPC share the A/B is
+# measuring.  0 skips the cell.
+SCALEOUT_RTT_S = _env_float("KVTPU_BENCH_SCALEOUT_RTT_S", 0.002)
 # The pinned failover degradation envelope (docs/replication.md): the
 # post-kill hit rate over the measurement window may dip at most this
 # far below the pre-kill window — the follower's standby slice is warm,
@@ -2420,15 +2440,32 @@ def bench_replica_scaleout(
     out: dict = {"dip_envelope": SCALEOUT_DIP_ENVELOPE}
 
     # ---- cell 1: multi-replica scores/sec + parity -------------------
-    def new_indexer(index=None) -> Indexer:
+    def new_indexer(
+        index=None,
+        pipeline_depth=None,
+        score_memo=0,
+        exact_tokenize=False,
+    ) -> Indexer:
+        # exact_tokenize (the cache_analytics precedent): a ratio
+        # above 1.0 makes the prefix store's serve path unreachable,
+        # so warm repeats re-walk the chain in chunks instead of
+        # collapsing to one pre-hashed slice — the pipelined A/B
+        # prices the chunked drive, which the serve path would mask.
+        tokenization_config = (
+            TokenizationPoolConfig(min_prefix_overlap_ratio=1.01)
+            if exact_tokenize
+            else TokenizationPoolConfig()
+        )
         indexer = Indexer(
             IndexerConfig(
                 token_processor_config=TokenProcessorConfig(
                     block_size=BLOCK_SIZE
                 ),
                 kvblock_index_config=IndexConfig(),
-                score_memo_size=0,
+                tokenizers_pool_config=tokenization_config,
+                score_memo_size=score_memo,
                 cache_stats=False,
+                pipeline_depth=pipeline_depth,
             ),
             tokenizer=WordTokenizer(),
             kv_block_index=index,
@@ -2583,6 +2620,250 @@ def bench_replica_scaleout(
         over1.shutdown()
         cluster3.close()
         cluster1.close()
+
+    # ---- pipelined A/B: read-path fan-out pipelining ------------------
+    # (docs/replication.md "Pipelined read path").  The cells above run
+    # in-process transports whose whole "RPC" is cheaper than a thread
+    # handoff, so adaptive arming correctly keeps them sequential; this
+    # cell injects a realistic per-call RTT and runs the same warm
+    # multi-turn workload through the sequential parity oracle
+    # (fanout_workers=0 + pipeline_depth=0) and the overlapped +
+    # pipelined drive (defaults, arming forced) on twin 3-replica
+    # clusters: scores asserted identical, warm throughput asserted
+    # >= 2x, pipelined warm p99 reported as a multiple of the RTT.  A
+    # cold cell (unique single-shot prompts, index misses) prices the
+    # speculation overhead, and a memo cell pins memo-hit repeats at
+    # ~single-process rates with ZERO lookup RPC rounds.  Profiler
+    # captures around both warm cells give the before/after
+    # main-thread remote_index.py wall share (ROADMAP item 3's
+    # acceptance: the sequential fan-out share must shrink).
+    class _RttTransport:
+        """Transport decorator charging one synthetic RTT per call."""
+
+        def __init__(self, inner, rtt_s: float) -> None:
+            self._inner = inner
+            self._rtt_s = rtt_s
+            self.supports_deadline = getattr(
+                inner, "supports_deadline", False
+            )
+
+        def call(self, method, args):
+            time.sleep(self._rtt_s)
+            return self._inner.call(method, args)
+
+        def call_ex(self, method, args, traceparent=None):
+            time.sleep(self._rtt_s)
+            return self._inner.call_ex(
+                method, args, traceparent=traceparent
+            )
+
+        def call_vv(self, method, args, traceparent=None, timeout=None):
+            time.sleep(self._rtt_s)
+            return self._inner.call_vv(
+                method, args, traceparent=traceparent, timeout=timeout
+            )
+
+    def _main_remote_share(prof) -> Optional[float]:
+        # Main-thread wall share inside cluster/remote_index.py: the
+        # sequential drive blocks THERE (transport waits under _call);
+        # the pipelined drive blocks in the indexer's handle.result()
+        # while pool threads do the waiting, so the share collapsing
+        # is exactly the pipelining landing.
+        total = hits = 0
+        for line in prof.collapsed().splitlines():
+            stack, _, count_text = line.rpartition(" ")
+            if not stack.startswith("main;"):
+                continue
+            count = int(count_text)
+            total += count
+            if "cluster/remote_index.py" in stack:
+                hits += count
+        return round(hits / total, 4) if total else None
+
+    rtt_s = SCALEOUT_RTT_S
+    if rtt_s > 0.0:
+        wrap = lambda _rid, t: _RttTransport(t, rtt_s)  # noqa: E731
+        seq_cluster = LocalCluster(
+            fanout_workers=0, transport_wrap=wrap
+        )
+        pipe_cluster = LocalCluster(
+            overlap_min_rpc_s=0.0, transport_wrap=wrap
+        )
+        seq_ix = new_indexer(
+            seq_cluster.remote_index,
+            pipeline_depth=0,
+            exact_tokenize=True,
+        )
+        pipe_ix = new_indexer(
+            pipe_cluster.remote_index, exact_tokenize=True
+        )
+        memo_pipe = new_indexer(
+            pipe_cluster.remote_index,
+            score_memo=256,
+            exact_tokenize=True,
+        )
+        memo_single = new_indexer(score_memo=256, exact_tokenize=True)
+        try:
+            for indexer in (seq_ix, pipe_ix, memo_single):
+                seed_index(indexer)
+            ab_parity = True
+            for prompt in prompts:
+                if seq_ix.get_pod_scores(
+                    prompt, MODEL_NAME, pods
+                ) != pipe_ix.get_pod_scores(prompt, MODEL_NAME, pods):
+                    ab_parity = False
+
+            prof_before = _Prof(_ProfCfg(hz=fan_hz))
+            prof_before.start()
+            seq_warm = run_cell(seq_ix)
+            prof_before.close()
+            prof_after = _Prof(_ProfCfg(hz=fan_hz))
+            prof_after.start()
+            pipe_warm = run_cell(pipe_ix)
+            prof_after.close()
+            before_share = _main_remote_share(prof_before)
+            after_share = _main_remote_share(prof_after)
+            speedup = (
+                round(
+                    pipe_warm["scores_per_sec"]
+                    / seq_warm["scores_per_sec"],
+                    2,
+                )
+                if seq_warm["scores_per_sec"]
+                else None
+            )
+
+            # Cold: unique prompts, every chain misses at block 0 —
+            # prices tokenize + first-chunk fan-out + the speculation
+            # a dead chain drops on the floor.
+            cold_rng = random.Random(401)
+            cold_pool = [
+                " ".join(
+                    f"c{cold_rng.randrange(1, 1 << 30)}"
+                    for _ in range(128)
+                )
+                for _ in range(320)
+            ]
+
+            def run_cold(indexer, cold_prompts) -> dict:
+                latencies: List[float] = []
+                for prompt in cold_prompts:
+                    t0 = time.perf_counter()
+                    indexer.get_pod_scores(prompt, MODEL_NAME, pods)
+                    latencies.append(time.perf_counter() - t0)
+                total = sum(latencies)
+                return {
+                    "scores_per_sec": (
+                        round(len(latencies) / total, 1)
+                        if total
+                        else 0.0
+                    ),
+                    "p99_us": round(
+                        float(np.percentile(latencies, 99)) * 1e6, 1
+                    ),
+                    "requests": len(latencies),
+                }
+
+            seq_cold = run_cold(seq_ix, cold_pool[:160])
+            pipe_cold = run_cold(pipe_ix, cold_pool[160:])
+
+            # Memo: repeats of one warm prompt must hit the memo (0
+            # lookup RPC rounds — touch_chain recency refreshes ride
+            # the off-thread pool) at ~the single-process memo rate.
+            def run_repeat(indexer, seconds: float) -> dict:
+                repeat_prompt = prompts[-1]
+                for _ in range(3):  # populate + validate the memo
+                    indexer.get_pod_scores(
+                        repeat_prompt, MODEL_NAME, pods
+                    )
+                count = 0
+                t0 = time.perf_counter()
+                deadline = t0 + seconds
+                while time.perf_counter() < deadline:
+                    indexer.get_pod_scores(
+                        repeat_prompt, MODEL_NAME, pods
+                    )
+                    count += 1
+                elapsed = time.perf_counter() - t0
+                return {
+                    "scores_per_sec": (
+                        round(count / elapsed, 1) if elapsed else 0.0
+                    ),
+                    "requests": count,
+                }
+
+            memo_parity = memo_pipe.get_pod_scores(
+                prompts[-1], MODEL_NAME, pods
+            ) == memo_single.get_pod_scores(prompts[-1], MODEL_NAME, pods)
+            # Converge the memo first: request 1 stores a sentinel
+            # vector (nothing piggybacked yet), request 2 recomputes
+            # against the now-real vector, request 3+ hit.  Only THEN
+            # pin zero lookup rounds.
+            for _ in range(3):
+                memo_pipe.get_pod_scores(prompts[-1], MODEL_NAME, pods)
+            rounds_before = pipe_cluster.remote_index.rpc_stats()[
+                "critical_path"
+            ]["lookup_calls"]
+            memo_pipe_cell = run_repeat(memo_pipe, cell_s / 2)
+            hit_rounds = (
+                pipe_cluster.remote_index.rpc_stats()["critical_path"][
+                    "lookup_calls"
+                ]
+                - rounds_before
+            )
+            memo_single_cell = run_repeat(memo_single, cell_s / 2)
+
+            pipe_stats = pipe_cluster.remote_index.rpc_stats()
+            out["pipelined_ab"] = {
+                "rtt_us": round(rtt_s * 1e6, 1),
+                "parity": "ok" if ab_parity else "MISMATCH",
+                "sequential_warm": seq_warm,
+                "pipelined_warm": pipe_warm,
+                "speedup_warm": speedup,
+                "speedup_ok": (
+                    speedup is not None and speedup >= 2.0
+                ),
+                "p99_rtt_ratio": round(
+                    pipe_warm["p99_us"] / (rtt_s * 1e6), 2
+                ),
+                "sequential_cold": seq_cold,
+                "pipelined_cold": pipe_cold,
+                "memo_warm": {
+                    "pipelined_sps": memo_pipe_cell["scores_per_sec"],
+                    "single_sps": memo_single_cell["scores_per_sec"],
+                    "ratio": (
+                        round(
+                            memo_pipe_cell["scores_per_sec"]
+                            / memo_single_cell["scores_per_sec"],
+                            3,
+                        )
+                        if memo_single_cell["scores_per_sec"]
+                        else None
+                    ),
+                    "hit_lookup_rounds": hit_rounds,
+                    "hit_rounds_ok": hit_rounds == 0,
+                    "parity": memo_parity,
+                },
+                "profile": {
+                    "hz": fan_hz,
+                    "before_share": before_share,
+                    "after_share": after_share,
+                    "improved": (
+                        before_share is not None
+                        and after_share is not None
+                        and after_share < before_share
+                    ),
+                },
+                "rpc": pipe_stats["critical_path"],
+                "fanout": pipe_stats["fanout"],
+            }
+        finally:
+            seq_ix.shutdown()
+            pipe_ix.shutdown()
+            memo_pipe.shutdown()
+            memo_single.shutdown()
+            seq_cluster.close()
+            pipe_cluster.close()
 
     # ---- cell 2: failover hit-rate dip --------------------------------
     n = len(requests)

@@ -84,6 +84,7 @@ GATED_HEADLINES = (
     "event_storm.consistency",
     "replica_scaleout.single_sps",
     "replica_scaleout.cluster3_sps",
+    "replica_scaleout.pipelined_sps",
 )
 
 
@@ -191,6 +192,18 @@ def extract_headlines(artifact: dict) -> Dict[str, float]:
                 value = _num(cell.get("scores_per_sec"))
         if value is not None and value > 0:
             out[key] = value
+    # Pipelined read-path A/B (RTT-injected 3-replica warm cell):
+    # compact carries the terse pipelined_sps; the full artifact nests
+    # it under pipelined_ab.pipelined_warm.
+    value = _num(scaleout.get("pipelined_sps"))
+    if value is None:
+        ab = scaleout.get("pipelined_ab")
+        if isinstance(ab, dict):
+            cell = ab.get("pipelined_warm")
+            if isinstance(cell, dict):
+                value = _num(cell.get("scores_per_sec"))
+    if value is not None and value > 0:
+        out["replica_scaleout.pipelined_sps"] = value
     return out
 
 

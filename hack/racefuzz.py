@@ -66,6 +66,7 @@ DEFAULT_STORMS = [
     "tests/test_concurrency.py::TestBackendStorm",
     "tests/test_concurrency.py::TestShardedIndexStorm",
     "tests/test_concurrency.py::TestScoreMemoStorm",
+    "tests/test_concurrency.py::TestClusterFanoutStorm",
     "tests/test_concurrency.py",
     "tests/test_kvevents_fuzz.py::TestPoolSurvivesStorm",
 ]

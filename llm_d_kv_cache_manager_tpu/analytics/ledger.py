@@ -600,6 +600,20 @@ class CacheStatsLedger:
             stats = self._stripes[stripe_index].get(family)
             return stats.ewma_interarrival_s if stats is not None else None
 
+    def predicted_matched_blocks(self, family: int) -> Optional[float]:
+        """Average matched blocks per request for a tracked family —
+        the read path's chain-speculation depth signal: a multi-turn
+        family that historically matched deep justifies dispatching
+        the next chunk's lookups before the current chunk resolves
+        (docs/replication.md "Pipelined read path").  None when the
+        family is untracked."""
+        stripe_index = family & self._mask
+        with self._stripe_locks[stripe_index]:
+            stats = self._stripes[stripe_index].get(family)
+            if stats is None or not stats.requests:
+                return None
+            return stats.blocks_matched / stats.requests
+
     def reuse_predictions(self):
         """Bulk export of the reuse signal: ``(family,
         ewma_interarrival_s, last_seen, requests)`` for every tracked

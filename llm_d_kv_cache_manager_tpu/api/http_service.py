@@ -1388,7 +1388,29 @@ def main() -> None:  # pragma: no cover - CLI entry
             misses=int(os.environ.get("CLUSTER_HEARTBEAT_MISSES", "2")),
         )
         cluster_heartbeat.start()
-        cluster_remote_index = RemoteIndex(cluster_membership)
+        # Explicit env resolution for the fan-out knobs (the
+        # RemoteIndex would resolve them itself; naming them here
+        # keeps the router's tuning surface discoverable —
+        # docs/configuration.md): CLUSTER_FANOUT_WORKERS (0 =
+        # sequential parity oracle), CLUSTER_FANOUT_BUDGET_S (whole
+        # fan-out deadline across re-routes), CLUSTER_VV_TTL_S
+        # (version-vector staleness bound for the score memo),
+        # CLUSTER_OVERLAP_MIN_RPC_S (adaptive-arming latency
+        # threshold; 0 forces overlap always-on).
+        from llm_d_kv_cache_manager_tpu.cluster.remote_index import (
+            resolve_fanout_budget_env,
+            resolve_fanout_workers_env,
+            resolve_overlap_min_rpc_env,
+            resolve_vv_ttl_env,
+        )
+
+        cluster_remote_index = RemoteIndex(
+            cluster_membership,
+            fanout_workers=resolve_fanout_workers_env(),
+            fanout_budget_s=resolve_fanout_budget_env(),
+            vv_ttl_s=resolve_vv_ttl_env(),
+            overlap_min_rpc_s=resolve_overlap_min_rpc_env(),
+        )
         injected_index = cluster_remote_index
         if config.kvblock_index_config.enable_metrics:
             from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import (  # noqa: E501 - lazy: mirrors new_index's wrap
@@ -1909,6 +1931,8 @@ def main() -> None:  # pragma: no cover - CLI entry
             persistence.close()
         if cluster_heartbeat is not None:
             cluster_heartbeat.close()
+        if cluster_remote_index is not None:
+            cluster_remote_index.close()
         for follower in cluster_followers:
             follower.close()
         if cluster_replica is not None:

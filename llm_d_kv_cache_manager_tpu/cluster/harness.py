@@ -55,6 +55,11 @@ class LocalCluster:
         strict_wire: bool = False,
         heartbeat_interval_s: float = 0.5,
         follower_poll_s: float = 0.1,
+        fanout_workers: Optional[int] = None,
+        fanout_budget_s: Optional[float] = None,
+        vv_ttl_s: Optional[float] = None,
+        overlap_min_rpc_s: Optional[float] = None,
+        transport_wrap=None,
     ) -> None:
         self.replicas: Dict[str, ClusterReplica] = {}
         self.transports: Dict[str, LocalReplicaTransport] = {}
@@ -74,8 +79,26 @@ class LocalCluster:
             self.transports[replica_id] = LocalReplicaTransport(
                 replica, strict_wire=strict_wire
             )
-        self.membership = ClusterMembership(dict(self.transports))
-        self.remote_index = RemoteIndex(self.membership)
+        # transport_wrap(replica_id, transport) -> transport lets the
+        # bench/chaos harnesses inject latency or faults on the wire
+        # the ROUTER sees; kill()/revive() still drive the raw
+        # transport underneath (shared killed-flag).
+        routed = {
+            replica_id: (
+                transport
+                if transport_wrap is None
+                else transport_wrap(replica_id, transport)
+            )
+            for replica_id, transport in self.transports.items()
+        }
+        self.membership = ClusterMembership(routed)
+        self.remote_index = RemoteIndex(
+            self.membership,
+            fanout_workers=fanout_workers,
+            fanout_budget_s=fanout_budget_s,
+            vv_ttl_s=vv_ttl_s,
+            overlap_min_rpc_s=overlap_min_rpc_s,
+        )
         self.heartbeat = HeartbeatMonitor(
             self.membership, interval_s=heartbeat_interval_s
         )
@@ -117,6 +140,7 @@ class LocalCluster:
 
     def close(self) -> None:
         self.heartbeat.close()
+        self.remote_index.close()
         for follower in self.followers:
             follower.close()
         for transport in self.transports.values():

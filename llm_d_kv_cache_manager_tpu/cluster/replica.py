@@ -8,11 +8,22 @@ cluster smoke) and the HTTP endpoint (``POST /replica`` in
 
 Wire format (canonical CBOR, the house serialization — lists only, no
 maps): request ``[method, args]`` or ``[method, args, traceparent]``,
-response ``[status, payload]`` or ``[status, payload, spans]`` with
-status 0=ok / 1=application error (payload is the message).  Transport
-failures raise :class:`ReplicaUnavailable`; application errors raise
+response ``[status, payload]``, ``[status, payload, spans]``, or
+``[status, payload, spans, vector]`` with status 0=ok /
+1=application error (payload is the message).  Transport failures
+raise :class:`ReplicaUnavailable`; application errors raise
 :class:`ReplicaError` — the router treats only the former as a
 failover trigger.
+
+Version piggyback (docs/replication.md "Pipelined read path"): every
+successful reply may carry the replica backend's per-shard version
+snapshot as a fourth element (the lists-only codec has no None, so a
+reply with a vector but no spans carries ``[]`` in the spans slot).
+The router folds the vectors into its cluster-wide
+``version_vector()`` so the exact-prompt score memo can validate
+against the whole cluster without extra RPCs.
+``CLUSTER_VERSION_PIGGYBACK=0`` keeps replies at three elements for
+rolling upgrades past routers whose decoder predates the fourth slot.
 
 Trace piggyback (docs/observability.md "Fleet tracing"): a request
 whose third element is a sampled W3C ``traceparent`` makes the replica
@@ -78,6 +89,17 @@ def resolve_trace_piggyback_env() -> bool:
     return raw.strip().lower() not in ("0", "false", "off", "no")
 
 
+def resolve_version_piggyback_env() -> bool:
+    """CLUSTER_VERSION_PIGGYBACK: "0"/"false"/"off" keeps replies at
+    three elements (mixed-version fleets whose routers predate the
+    vector slot); unset/anything else piggybacks the backend's version
+    snapshot on every successful reply (docs/replication.md)."""
+    raw = os.environ.get("CLUSTER_VERSION_PIGGYBACK")
+    if raw is None:
+        return True
+    return raw.strip().lower() not in ("0", "false", "off", "no")
+
+
 class ReplicaError(RuntimeError):
     """The replica executed the call and reports an application error."""
 
@@ -130,24 +152,57 @@ def decode_request(data: bytes) -> Tuple[str, list, Optional[str]]:
     return method, args, traceparent
 
 
-def encode_response(status: int, payload, spans: Optional[list] = None) -> bytes:
+def encode_response(
+    status: int,
+    payload,
+    spans: Optional[list] = None,
+    vector: Optional[list] = None,
+) -> bytes:
+    """Shortest frame that carries what is present: the lists-only
+    codec has no None, so a vector with no spans rides behind an empty
+    spans placeholder (decoders map ``[]`` back to "no spans")."""
+    if vector is not None:
+        return encode_canonical([status, payload, spans or [], vector])
     if spans is None:
         return encode_canonical([status, payload])
     return encode_canonical([status, payload, spans])
 
 
-def decode_response_ex(data: bytes) -> Tuple[object, Optional[list]]:
-    """(payload, piggybacked span records or None); raises
-    :class:`ReplicaError` on a status-1 frame."""
+def _decode_response_frame(
+    data: bytes,
+) -> Tuple[object, Optional[list], Optional[list]]:
     doc = decode_canonical(data)
-    if not isinstance(doc, list) or len(doc) not in (2, 3):
+    if not isinstance(doc, list) or len(doc) not in (2, 3, 4):
         raise CborDecodeError("unexpected replica response shape")
     status, payload = doc[0], doc[1]
-    spans = doc[2] if len(doc) == 3 else None
+    spans = doc[2] if len(doc) >= 3 else None
+    vector = doc[3] if len(doc) == 4 else None
     if spans is not None and not isinstance(spans, list):
+        raise CborDecodeError("unexpected replica response shape")
+    if vector is not None and not isinstance(vector, list):
         raise CborDecodeError("unexpected replica response shape")
     if status:
         raise ReplicaError(str(payload))
+    # [] in the spans slot is the "vector but no spans" placeholder.
+    if spans is not None and not spans and vector is not None:
+        spans = None
+    return payload, spans, vector
+
+
+def decode_response_vv(
+    data: bytes,
+) -> Tuple[object, Optional[list], Optional[list]]:
+    """(payload, piggybacked spans or None, piggybacked version vector
+    or None); raises :class:`ReplicaError` on a status-1 frame."""
+    return _decode_response_frame(data)
+
+
+def decode_response_ex(data: bytes) -> Tuple[object, Optional[list]]:
+    """(payload, piggybacked span records or None); raises
+    :class:`ReplicaError` on a status-1 frame.  Tolerates (and drops)
+    the four-element vector frame so pre-vector callers keep working
+    against new replicas."""
+    payload, spans, _ = _decode_response_frame(data)
     return payload, spans
 
 
@@ -207,7 +262,14 @@ class ClusterReplica:
     # shows, all children of the router's "cluster.rpc" span.
     _READ_METHODS = frozenset({"lookup", "lookup_chain"})
     _ADMIN_METHODS = frozenset(
-        {"ping", "get_request_key", "dump_entries", "sync_snapshot"}
+        {
+            "ping",
+            "get_request_key",
+            "dump_entries",
+            "sync_snapshot",
+            "version_vector",
+            "touch_chain",
+        }
     )
 
     def __init__(
@@ -217,6 +279,7 @@ class ClusterReplica:
         journal=None,
         journal_retain_segments: int = 64,
         trace_piggyback: Optional[bool] = None,
+        version_piggyback: Optional[bool] = None,
     ) -> None:
         if not replica_id:
             raise ValueError("replica_id required")
@@ -229,6 +292,15 @@ class ClusterReplica:
             resolve_trace_piggyback_env()
             if trace_piggyback is None
             else trace_piggyback
+        )
+        # Piggyback the backend's per-shard version snapshot on every
+        # successful reply (None -> CLUSTER_VERSION_PIGGYBACK, default
+        # on).  Off keeps the three-element reply frame for rolling
+        # upgrades past pre-vector routers.
+        self.version_piggyback = (
+            resolve_version_piggyback_env()
+            if version_piggyback is None
+            else version_piggyback
         )
         # Replication journals have no snapshot boundary to compact
         # against, so they get size-based retention: the newest N
@@ -250,7 +322,24 @@ class ClusterReplica:
             "restore_entries": self._restore_entries,
             "purge_pod": self._purge_pod,
             "sync_snapshot": self._sync_snapshot,
+            "version_vector": self._version_vector,
+            "touch_chain": self._touch_chain,
         }
+
+    def vector_snapshot(self) -> Optional[List[int]]:
+        """The backend's per-shard version snapshot as wire-ready ints,
+        or None when the backend has no ``version_vector`` surface (the
+        reply then stays vector-free and the router's memo treats this
+        replica as unknown)."""
+        version_vector = getattr(self.index, "version_vector", None)
+        if not callable(version_vector):
+            return None
+        try:
+            return [int(v) for v in version_vector()]
+        except Exception:  # noqa: BLE001 piggyback is advisory; kvlint: disable=KV005
+            # A backend whose snapshot raises just ships a vector-free
+            # reply; the router's memo treats the replica as unknown.
+            return None
 
     def close(self) -> None:
         if self.journal is not None:
@@ -346,7 +435,10 @@ class ClusterReplica:
                 logger.exception(
                     "replica %s span piggyback failed", self.replica_id
                 )
-        return encode_response(0, payload, spans)
+        vector = (
+            self.vector_snapshot() if self.version_piggyback else None
+        )
+        return encode_response(0, payload, spans, vector)
 
     # -- methods --------------------------------------------------------
 
@@ -488,6 +580,23 @@ class ClusterReplica:
             self._journal_tick()
         return removed
 
+    def _version_vector(self, args):
+        """Explicit vector fetch (ring-change refresh and the local
+        transport's non-wire path); [] when the backend has no
+        version surface — the router's memo then never validates
+        against this replica."""
+        return self.vector_snapshot() or []
+
+    def _touch_chain(self, args):
+        """Recency-only LRU touch for a memoized chain's keys.  Never
+        journaled: followers rebuild recency from their own traffic,
+        and a lost touch costs at worst one early eviction."""
+        (keys,) = args
+        touch = getattr(self.index, "touch_chain", None)
+        if callable(touch):
+            touch([int(k) for k in keys])
+        return None
+
     def _sync_snapshot(self, args):
         """Follower bootstrap: journal boundary (rotate + per-pod
         watermarks) then a dump taken AFTER it — every record below the
@@ -520,6 +629,11 @@ class LocalReplicaTransport:
     failover trigger for tests, the bench, and the smoke.
     """
 
+    # In-process calls either succeed immediately or fail immediately
+    # (kill()), so a per-call deadline is accepted and trivially met —
+    # the router's budget accounting stays uniform across transports.
+    supports_deadline = True
+
     def __init__(
         self, replica: ClusterReplica, strict_wire: bool = False
     ) -> None:
@@ -534,7 +648,7 @@ class LocalReplicaTransport:
         self._killed.clear()
 
     def call(self, method: str, args: list):
-        payload, _ = self.call_ex(method, args)
+        payload, _, _ = self.call_vv(method, args)
         return payload
 
     def call_ex(
@@ -543,22 +657,40 @@ class LocalReplicaTransport:
         args: list,
         traceparent: Optional[str] = None,
     ) -> Tuple[object, Optional[list]]:
-        """(payload, piggybacked spans).  The non-strict path runs the
-        handler on the CALLER's thread, so an active trace receives
-        the replica-side spans directly through the context var — no
-        piggyback needed (None); the strict path round-trips the full
-        wire contract including the trace context."""
+        payload, spans, _ = self.call_vv(method, args, traceparent)
+        return payload, spans
+
+    def call_vv(
+        self,
+        method: str,
+        args: list,
+        traceparent: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> Tuple[object, Optional[list], Optional[list]]:
+        """(payload, piggybacked spans, piggybacked version vector).
+        The non-strict path runs the handler on the CALLER's thread, so
+        an active trace receives the replica-side spans directly
+        through the context var — no piggyback needed (None) — and the
+        vector is read off the backend post-apply; the strict path
+        round-trips the full wire contract including the trace context
+        and the vector frame."""
         if self._killed.is_set():
             raise ReplicaUnavailable(
                 f"replica {self.replica.replica_id} is down",
                 kind="killed",
             )
         if not self.strict_wire:
-            return self.replica.handle(method, args), None
+            payload = self.replica.handle(method, args)
+            vector = (
+                self.replica.vector_snapshot()
+                if self.replica.version_piggyback
+                else None
+            )
+            return payload, None, vector
         response = self.replica.handle_wire(
             encode_request(method, args, traceparent)
         )
-        return decode_response_ex(response)
+        return decode_response_vv(response)
 
     def close(self) -> None:
         return None
@@ -568,11 +700,17 @@ class HttpReplicaTransport:
     """HTTP transport: ``POST /replica`` with a CBOR body.
 
     One ``http.client`` connection per calling thread (the router's
-    scoring threads and kvevents workers call concurrently); any
+    scoring threads, fan-out executor workers, and kvevents workers
+    call concurrently — each worker reuses its own connection); any
     transport-level failure closes the connection and raises
     :class:`ReplicaUnavailable` — retries are the router's decision,
     not the transport's.
     """
+
+    # Per-call timeouts tighten (never extend) the construction-time
+    # timeout so a re-routed retry spends only the fan-out budget's
+    # remainder (docs/replication.md "Deadline budget").
+    supports_deadline = True
 
     def __init__(
         self,
@@ -632,7 +770,7 @@ class HttpReplicaTransport:
         return "io"
 
     def call(self, method: str, args: list):
-        payload, _ = self.call_ex(method, args)
+        payload, _, _ = self.call_vv(method, args)
         return payload
 
     def call_ex(
@@ -641,9 +779,32 @@ class HttpReplicaTransport:
         args: list,
         traceparent: Optional[str] = None,
     ) -> Tuple[object, Optional[list]]:
+        payload, spans, _ = self.call_vv(method, args, traceparent)
+        return payload, spans
+
+    def _apply_timeout(self, conn, timeout: Optional[float]) -> None:
+        """Clamp this call's socket timeout to the remaining deadline
+        budget (never above the construction-time timeout); the
+        connection is thread-local, so resetting it every call keeps
+        reuse safe."""
+        effective = self._timeout
+        if timeout is not None:
+            effective = max(0.05, min(timeout, self._timeout))
+        conn.timeout = effective
+        if conn.sock is not None:
+            conn.sock.settimeout(effective)
+
+    def call_vv(
+        self,
+        method: str,
+        args: list,
+        traceparent: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> Tuple[object, Optional[list], Optional[list]]:
         body = encode_request(method, args, traceparent)
         try:
             conn = self._connection()
+            self._apply_timeout(conn, timeout)
             conn.request(
                 "POST", "/replica", body=body, headers=self._headers
             )
@@ -664,7 +825,7 @@ class HttpReplicaTransport:
                 kind="http_status",
             )
         try:
-            return decode_response_ex(data)
+            return decode_response_vv(data)
         except CborDecodeError as exc:
             self._drop_connection()
             raise ReplicaUnavailable(

@@ -22,12 +22,20 @@ cold prompt stops paying for its unreachable suffix).  Scores are
 bit-identical to the straight-line path (pinned by property tests);
 ``READ_PATH_FAST_LANE=0`` or ``IndexerConfig.read_path_fast_lane=False``
 restores the straight-line path.
+
+Against a backend that fans lookups out over the wire (the cluster
+``RemoteIndex``), the chunked drive additionally pipelines: chunk N+1
+is hashed and dispatched while chunk N's owner RPCs are in flight, and
+predicted-deep chains (score memo / analytics ledger) speculate further
+ahead (``CLUSTER_PIPELINE_DEPTH`` / ``CLUSTER_SPECULATE``; scores stay
+bit-identical — docs/replication.md).
 """
 
 from __future__ import annotations
 
 import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -81,9 +89,15 @@ DEFAULT_LOOKUP_CHUNK = 32
 # the index's per-shard version vector); 0 disables.
 DEFAULT_SCORE_MEMO = 256
 
+# Chunks the fast lane keeps in flight against an async-capable index
+# backend (the cluster RemoteIndex): chunk N+1 is hashed and dispatched
+# while chunk N's owner RPCs are on the wire.  0 forces the sequential
+# drive (the bit-identical parity oracle; docs/replication.md).
+DEFAULT_PIPELINE_DEPTH = 3
+
 # One-shot guard for the memo-self-disable warning (every Indexer over
-# a RemoteIndex hits the same condition; one line per process is the
-# signal, N lines is noise).
+# the same memo-incapable backend hits the same condition; one line per
+# process is the signal, N lines is noise).
 _MEMO_DISABLED_WARNED = False
 
 
@@ -131,6 +145,35 @@ def _env_score_memo_default() -> Optional[int]:
         return max(0, int(text))
     except ValueError:
         return DEFAULT_SCORE_MEMO
+
+
+def _env_pipeline_depth_default() -> int:
+    """CLUSTER_PIPELINE_DEPTH: fast-lane chunks in flight at once when
+    the index backend exposes ``lookup_chain_async`` (the cluster
+    RemoteIndex); 0 keeps the strictly sequential chunk drive — the
+    bit-identical parity oracle (docs/replication.md)."""
+    raw = os.environ.get("CLUSTER_PIPELINE_DEPTH", "")
+    if not raw:
+        return DEFAULT_PIPELINE_DEPTH
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        logger.warning(
+            "invalid CLUSTER_PIPELINE_DEPTH=%r; using %d",
+            raw,
+            DEFAULT_PIPELINE_DEPTH,
+        )
+        return DEFAULT_PIPELINE_DEPTH
+
+
+def _env_speculate_default() -> bool:
+    """CLUSTER_SPECULATE: "0"/"false"/"off" restricts the pipeline to
+    plain one-ahead overlap; on (the default) lets a predicted-deep
+    chain (score memo / analytics ledger) dispatch further ahead."""
+    raw = os.environ.get("CLUSTER_SPECULATE")
+    if raw is None:
+        return True
+    return raw.strip().lower() not in ("0", "false", "off")
 
 
 class _ScoreMemoEntry:
@@ -238,9 +281,27 @@ class IndexerConfig:
     # any add/evict/purge/restore invalidates.  0 disables; None
     # resolves from READ_PATH_SCORE_MEMO (default 256).  Requires an
     # index backend exposing version_vector/touch_chain (the in-memory
-    # backend; others silently run without the memo).  Entries pin
-    # their prompt strings, so memory is O(size x prompt length).
+    # backend and the cluster RemoteIndex; others silently run without
+    # the memo).  Entries pin their prompt strings, so memory is
+    # O(size x prompt length).
     score_memo_size: Optional[int] = None
+    # Read-path chunk pipelining (docs/replication.md): against a
+    # backend exposing lookup_chain_async (the cluster RemoteIndex),
+    # the fast lane keeps up to this many chunks in flight — chunk N+1
+    # is hashed and dispatched while chunk N's owner RPCs are on the
+    # wire, and a chain dead for every pod drops the speculative
+    # in-flight results on the floor.  0 forces the sequential drive
+    # (the bit-identical parity oracle); None resolves from
+    # CLUSTER_PIPELINE_DEPTH (default 3).  Scores are bit-identical
+    # either way (tests/test_cluster_pipeline.py pins it).
+    pipeline_depth: Optional[int] = None
+    # Chain speculation: depth > 1 dispatch ahead is gated on a
+    # likely-alive-deep prediction (the score memo's last matched
+    # depth for this exact prompt, or the analytics ledger's average
+    # matched blocks for the family).  None resolves from
+    # CLUSTER_SPECULATE (default on); False limits the pipeline to
+    # one-ahead overlap.
+    speculate: Optional[bool] = None
     # Cache-efficiency analytics (analytics/ledger.py): every scored
     # request feeds the hit-attribution ledger, outside index locks,
     # gated by CACHESTATS_SAMPLE_RATE.  None resolves from the
@@ -323,6 +384,14 @@ class Indexer:
         if self.config.lookup_chunk_size <= 0:
             raise ValueError("lookup_chunk_size must be positive")
         self._lookup_chunk = self.config.lookup_chunk_size
+        pipeline_depth = self.config.pipeline_depth
+        if pipeline_depth is None:
+            pipeline_depth = _env_pipeline_depth_default()
+        self._pipeline_depth = max(0, int(pipeline_depth))
+        speculate = self.config.speculate
+        if speculate is None:
+            speculate = _env_speculate_default()
+        self._speculate = bool(speculate)
         # Hash-space identity for block-key memoization; None when the
         # token processor does not expose one (custom TokenProcessor
         # implementations) — the fast lane then runs without memo.
@@ -354,11 +423,12 @@ class Indexer:
         ) and callable(getattr(self.kv_block_index, "touch_chain", None))
         if memo_wanted and memo_supported:
             self._score_memo = LRUCache(memo_size)
-        # The silent self-disable was invisible to operators: a fleet
-        # deployment (RemoteIndex has no version_vector) pays the full
-        # walk on warm repeats while a single-process one memoizes —
-        # the gauge + one-shot warning make that difference
-        # diagnosable (docs/observability.md).  The gauge LATCHES to 1
+        # The silent self-disable was invisible to operators: a
+        # deployment over a backend without version_vector pays the
+        # full walk on warm repeats while a memo-capable one (the
+        # in-memory backend, the cluster RemoteIndex) memoizes — the
+        # gauge + one-shot warning make that difference diagnosable
+        # (docs/observability.md).  The gauge LATCHES to 1
         # (never written back to 0): it is process-wide, and a later
         # memo-capable Indexer construction — embedders and tests
         # build several — must not wipe the serving indexer's signal.
@@ -373,9 +443,9 @@ class Indexer:
                 _MEMO_DISABLED_WARNED = True
                 logger.warning(
                     "request score memo disabled: index backend %s "
-                    "lacks version_vector/touch_chain (expected for "
-                    "the cluster RemoteIndex) — warm repeat prompts "
-                    "pay the full fan-out; kvtpu_score_memo_disabled=1",
+                    "lacks version_vector/touch_chain — warm repeat "
+                    "prompts pay the full walk; "
+                    "kvtpu_score_memo_disabled=1",
                     type(self.kv_block_index).__name__,
                 )
 
@@ -695,6 +765,11 @@ class Indexer:
                 prompt, model_name, render_req, self._key_space
             )
             s.set_attr("tokens", len(result.tokens))
+        # Anchor for the traced stage layout below: everything from
+        # here to the emit point belongs to some walk stage, so the
+        # stage spans are laid out to cover this whole interval (the
+        # slo smoke pins stage-sum ≈ end-to-end ±5%).
+        walk_start = time.perf_counter()
 
         tokens = result.tokens
         block_size = self.token_processor.block_size
@@ -709,6 +784,12 @@ class Indexer:
         pod_set = set(pod_identifiers) if pod_identifiers else None
 
         index = self.kv_block_index
+        # Chain-speculation depth signal (docs/replication.md): blocks
+        # the last walk of this exact prompt matched, harvested from a
+        # stale memo entry below — a multi-turn family whose prefix
+        # stayed deep predicts a likely-alive chain worth dispatching
+        # ahead of the current chunk's replies.
+        predicted_hit_blocks = 0
         if memo_key is not None and active_trace is None:
             # Exact-prompt score memo, validated optimistically: the
             # memoized result is served only when (1) tokenization
@@ -762,6 +843,8 @@ class Indexer:
                     len(hit.touch_keys),
                 )
                 return dict(hit.scores)
+            if hit is not None:
+                predicted_hit_blocks = hit.matched_blocks
         processor = self.token_processor
         scorer = self.scorer
         chain = scorer.begin(
@@ -787,40 +870,94 @@ class Indexer:
         memo_version = (
             index.version_vector() if memo_key is not None else None
         )
-        position = 0  # blocks consumed
+        position = 0  # blocks consumed (scored)
+        next_pos = 0  # blocks hashed + dispatched (>= position)
         alive = True
-        while position < total_blocks and alive:
+
+        def next_chunk() -> Sequence[int]:
+            """Hash (or slice from the prefix memo) the next
+            un-dispatched chunk, advancing the dispatch cursor.  Both
+            drives below share it, so chunk boundaries — hence scorer
+            advance granularity and scores — are identical."""
+            nonlocal hash_s, next_pos, parent_key, chunk_size
             t_0 = perf()
-            if position < memo_blocks:
+            if next_pos < memo_blocks:
                 # The memoized prefix needs no hashing, so early exit
                 # saves nothing there: drive it as ONE chunk (one
                 # grouped lock pass over the whole prefix).
-                key_chunk: Sequence[int] = (
+                chunk: Sequence[int] = (
                     memo_keys[:memo_blocks]
-                    if position == 0 and memo_blocks == len(memo_keys)
-                    else memo_keys[position:memo_blocks]
+                    if next_pos == 0 and memo_blocks == len(memo_keys)
+                    else memo_keys[next_pos:memo_blocks]
                 )
             else:
-                n_blocks = min(chunk_size, total_blocks - position)
+                n_blocks = min(chunk_size, total_blocks - next_pos)
                 suffix = tokens[
-                    position * block_size : (position + n_blocks) * block_size
+                    next_pos * block_size : (next_pos + n_blocks) * block_size
                 ]
-                key_chunk = processor.extend_block_keys(
+                chunk = processor.extend_block_keys(
                     parent_key, suffix, model_name
                 )
-                parent_key = key_chunk[-1] if key_chunk else parent_key
+                parent_key = chunk[-1] if chunk else parent_key
                 # Hash chunks double up to the cap: early exit stays
                 # fine-grained near the front of a cold chain (where
                 # breaks live) while a long live suffix amortizes the
                 # per-chunk overhead.
                 if chunk_size < 512:
                     chunk_size *= 2
-            t_1 = perf()
-            hash_s += t_1 - t_0
-            keys_done.extend(key_chunk)
-            pods_per_key = index.lookup_chain(key_chunk)
+            hash_s += perf() - t_0
+            next_pos += len(chunk)
+            return chunk
+
+        # Pipelined chunk drive (docs/replication.md): against a
+        # backend whose lookup_chain_async runs the owner fan-out off
+        # the calling thread (the cluster RemoteIndex), hash and
+        # dispatch chunk N+1 while chunk N's replies are on the wire.
+        # One chunk ahead is unconditional; deeper dispatch is chain
+        # speculation, gated on a likely-alive-deep prediction (the
+        # prefix-memo depth, a stale memo entry's matched depth, or
+        # the ledger's per-family average).  Results are consumed
+        # strictly in chain order on this thread, so scores stay
+        # bit-identical to the sequential drive — early exit just
+        # drops the speculative in-flight results on the floor.
+        depth = (
+            self._pipeline_depth
+            if callable(getattr(index, "lookup_chain_async", None))
+            else 0
+        )
+        in_flight: deque = deque()
+        speculated = 0
+        predicted_blocks = max(memo_blocks, predicted_hit_blocks)
+        ledger_predicted = ledger is None
+        while position < total_blocks and alive:
+            if depth > 0:
+                while len(in_flight) < depth and next_pos < total_blocks:
+                    if len(in_flight) >= 2 and not (
+                        self._speculate and next_pos < predicted_blocks
+                    ):
+                        break
+                    if in_flight:
+                        speculated += 1
+                    chunk = next_chunk()
+                    # Dispatch counts as lookup time: an unarmed (or
+                    # closed) router resolves the chunk inline right
+                    # here, and that wall time must land in the
+                    # index_lookup stage, not in an untracked gap
+                    # (the slo smoke pins stage-sum ≈ end-to-end).
+                    t_d = perf()
+                    handle = index.lookup_chain_async(chunk)
+                    lookup_s += perf() - t_d
+                    in_flight.append((chunk, handle))
+                key_chunk, handle = in_flight.popleft()
+                t_1 = perf()
+                pods_per_key = handle.result()
+            else:
+                key_chunk = next_chunk()
+                t_1 = perf()
+                pods_per_key = index.lookup_chain(key_chunk)
             t_2 = perf()
             lookup_s += t_2 - t_1
+            keys_done.extend(key_chunk)
             keys_hit += len(pods_per_key)
             if memo_key is not None and pods_per_key:
                 touched_keys.extend(key_chunk[: len(pods_per_key)])
@@ -848,6 +985,33 @@ class Indexer:
             )
             score_s += perf() - t_2
             position += len(key_chunk)
+            if (
+                not ledger_predicted
+                and depth > 1
+                and self._speculate
+                and len(keys_done)
+                >= min(ledger.config.family_blocks, total_blocks)
+            ):
+                # One mid-walk refinement: once enough of the chain is
+                # hashed to derive the family id, the ledger's average
+                # matched depth for it extends the speculation horizon
+                # (multi-turn families that historically match deep).
+                ledger_predicted = True
+                prediction = ledger.predicted_matched_blocks(
+                    ledger.family_key(keys_done, total_blocks)
+                )
+                if prediction is not None:
+                    predicted_blocks = max(
+                        predicted_blocks, int(prediction)
+                    )
+        if speculated or in_flight:
+            # Wasted = dispatched but never consumed (early exit after
+            # the chain died); the executor finishes them harmlessly in
+            # the background and their keys never reach keys_done, the
+            # prefix store, or the family id.
+            record_speculation = getattr(index, "record_speculation", None)
+            if callable(record_speculation):
+                record_speculation(speculated, len(in_flight))
 
         if (
             self._key_space is not None
@@ -942,10 +1106,14 @@ class Indexer:
             # One span per pipeline stage (the stage vocabulary the
             # metrics histogram and the debug surface share), durations
             # accumulated across chunks and emitted as contiguous
-            # intervals ending now.
+            # intervals covering [walk_start, now].  lookup/score keep
+            # their measured durations; hash_blocks absorbs the walk's
+            # fixed bookkeeping (memo check + version capture up front,
+            # memo store / ledger / prefix attach at the tail) so the
+            # stage sum tracks the request's end-to-end latency.
             end = perf()
             span = tracer.add_completed(
-                "hash_blocks", end - hash_s - lookup_s - score_s,
+                "hash_blocks", walk_start,
                 end - lookup_s - score_s,
             )
             span.set_attr("block_keys", len(keys_done))

@@ -87,10 +87,22 @@ class InstrumentedIndex(Index):
     # defined on this class) instead of plain methods.
 
     def __getattr__(self, name: str):
-        if name in ("add_mappings", "version_vector", "touch_chain"):
+        if name in (
+            "add_mappings",
+            "version_vector",
+            "touch_chain",
+            "lookup_chain_async",
+            "record_speculation",
+        ):
             # version_vector/touch_chain: the indexer's score memo
             # probes for the optimistic-validation surface the same
             # way (getattr), and neither needs metrics of its own.
+            # lookup_chain_async/record_speculation: the pipelined
+            # chunk drive probes for the async surface the same way;
+            # like lookup_chain, the async variant is deliberately
+            # un-instrumented — the fast lane records one
+            # request-granular observation itself
+            # (record_chain_lookup).
             return getattr(self._inner, name)
         if name == "add_entries_batch":
             inner_batch = getattr(self._inner, name)

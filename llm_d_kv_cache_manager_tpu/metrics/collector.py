@@ -434,14 +434,17 @@ class KVCacheMetrics:
         )
         # Score memo visibility (kvcache/indexer.py): 1 when the
         # exact-prompt memo was requested but self-disabled because the
-        # backend lacks version_vector/touch_chain (the RemoteIndex
-        # case) — the reason warm-traffic latency differs between
-        # single-process and fleet deployments.
+        # backend lacks version_vector/touch_chain.  The in-memory
+        # backend AND the cluster RemoteIndex (version-vectored since
+        # the pipelined read path; docs/replication.md) both support
+        # the memo, so a 1 here means a custom backend without the
+        # optimistic-validation surface.
         self.score_memo_disabled = Gauge(
             f"{_NAMESPACE}_score_memo_disabled",
             "1 when the request score memo is configured but disabled "
-            "by the index backend (no version_vector/touch_chain — "
-            "e.g. the cluster RemoteIndex), else 0.",
+            "by an index backend lacking version_vector/touch_chain, "
+            "else 0 (the in-memory backend and the cluster RemoteIndex "
+            "both support it).",
             registry=self.registry,
         )
         # SLO engine (obs/slo.py; docs/observability.md).

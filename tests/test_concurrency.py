@@ -629,3 +629,181 @@ class TestTTLCacheUnderContention:
         cache.delete("pod")
         cache._fire_eviction("pod", "stale-subscriber")
         assert evicted == [("pod", "stale-subscriber")]
+
+
+class TestClusterFanoutStorm:
+    """Pipelined fan-out vs membership kills: scoring readers drive the
+    overlapped cluster read path (chunk pipelining + concurrent owner
+    RPCs, arming forced) while a chaos thread kills and revives one
+    replica at a time.  Every replica is seeded with the FULL record
+    set (not just its slice + standby) and nothing writes during the
+    storm, so no matter how kills, re-routes, and late failure reports
+    interleave — a reader's in-flight mark_dead can land after the
+    chaos thread already revived the victim, briefly removing two
+    replicas from the ring — every read must equal the pre-storm
+    oracle, not merely 'no exceptions'."""
+
+    def test_pipelined_reads_survive_kill_revive(self, tmp_path):
+        from llm_d_kv_cache_manager_tpu.cluster import LocalCluster
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+            Indexer,
+            IndexerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+            EMPTY_BLOCK_HASH,
+            IndexConfig,
+            PodEntry,
+        )
+        from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+            TokenizationPoolConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.tokenization.tokenizers import (
+            Encoding,
+        )
+
+        class WordTokenizer:
+            def type(self):
+                return "storm-word"
+
+            def encode(self, prompt, model_name, add_special_tokens):
+                tokens, offsets, pos = [], [], 0
+                for word in prompt.split(" "):
+                    tokens.append(int(word[1:]))
+                    offsets.append((pos, pos + len(word)))
+                    pos += len(word) + 1
+                return Encoding(tokens=tokens, offsets=offsets)
+
+        cluster = LocalCluster(
+            journal_root=str(tmp_path),
+            # Force arming: the in-process transport's latency EWMA
+            # would otherwise keep the storm on the sequential path.
+            overlap_min_rpc_s=0,
+        )
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=4),
+                kvblock_index_config=IndexConfig(
+                    in_memory_config=InMemoryIndexConfig(size=50_000)
+                ),
+                # Exact tokenization keeps every read on the chunked
+                # drive (the prefix store would otherwise serve warm
+                # repeats as one pre-hashed chunk and the storm would
+                # stop exercising chunk pipelining after pass one).
+                tokenizers_pool_config=TokenizationPoolConfig(
+                    min_prefix_overlap_ratio=1.01
+                ),
+                read_path_fast_lane=True,
+                lookup_chunk_size=8,
+            ),
+            tokenizer=WordTokenizer(),
+            kv_block_index=cluster.remote_index,
+        )
+        indexer.run()
+        try:
+            rng = random.Random(11)
+            pods = [
+                PodEntry("pod-a", "hbm"),
+                PodEntry("pod-b", "host"),
+                PodEntry("pod-c", "shared_storage"),
+            ]
+            prompts = []
+            for _ in range(6):
+                tokens = [
+                    rng.randrange(1, 60_000) for _ in range(96)
+                ]
+                chain = indexer.token_processor.tokens_to_kv_block_keys(
+                    EMPTY_BLOCK_HASH, tokens, "m"
+                )
+                chosen = pods[: rng.randrange(1, len(pods) + 1)]
+                cluster.remote_index.add(chain, chain, chosen)
+                # Top every replica up to the full record set directly
+                # (adds are idempotent): the ring can then route a key
+                # anywhere during the storm — including the two-dead
+                # window a late mark_dead opens — without changing what
+                # a lookup returns.
+                for replica in cluster.replicas.values():
+                    replica.index.add(chain, chain, chosen)
+                prompts.append(" ".join(f"t{t}" for t in tokens))
+            # Drain the journal followers too, so the replication plane
+            # is quiet (not mid-apply) when the storm starts.
+            while cluster.sync_followers():
+                pass
+
+            # Two pre-storm passes pin the oracle and prove the read
+            # path is repeat-stable before any chaos starts.
+            oracle = [indexer.get_pod_scores(p, "m") for p in prompts]
+            assert all(oracle), oracle
+            assert [
+                indexer.get_pod_scores(p, "m") for p in prompts
+            ] == oracle
+
+            readers = THREADS - 1
+            errors = []
+            stop = threading.Event()
+            barrier = threading.Barrier(readers + 1)
+
+            def reader(worker_id):
+                r_rng = random.Random(200 + worker_id)
+                try:
+                    barrier.wait()
+                    for _ in range(OPS):
+                        pick = r_rng.randrange(len(prompts))
+                        scores = indexer.get_pod_scores(
+                            prompts[pick], "m"
+                        )
+                        assert scores == oracle[pick], (
+                            pick,
+                            scores,
+                            oracle[pick],
+                        )
+                except Exception as exc:  # pragma: no cover - failure
+                    errors.append(exc)
+
+            def chaos():
+                ids = list(cluster.replicas)
+                turn = 0
+                try:
+                    barrier.wait()
+                    while not stop.is_set():
+                        victim = ids[turn % len(ids)]
+                        turn += 1
+                        cluster.kill(victim)
+                        time.sleep(0.005)
+                        cluster.transports[victim].revive()
+                        cluster.membership.mark_alive(victim)
+                        time.sleep(0.005)
+                except Exception as exc:  # pragma: no cover - failure
+                    errors.append(exc)
+
+            threads = [
+                threading.Thread(target=reader, args=(i,))
+                for i in range(readers)
+            ]
+            chaos_thread = threading.Thread(target=chaos)
+            for t in threads:
+                t.start()
+            chaos_thread.start()
+            for t in threads:
+                t.join(timeout=120)
+            stop.set()
+            chaos_thread.join(timeout=30)
+            assert not errors, errors
+
+            # Quiesce: revive everyone, then the pipelined lane must
+            # still agree with the oracle AND the straight-path walk.
+            for replica_id in cluster.transports:
+                cluster.transports[replica_id].revive()
+                cluster.membership.mark_alive(replica_id)
+            for pick, prompt in enumerate(prompts):
+                assert indexer.get_pod_scores(prompt, "m") == oracle[pick]
+                assert (
+                    indexer._get_pod_scores_straight(prompt, "m")
+                    == oracle[pick]
+                )
+
+            stats = cluster.remote_index.rpc_stats()
+            assert stats["fanout"]["armed"], stats["fanout"]
+            assert stats["critical_path"]["speculative_rpcs"] > 0, stats
+        finally:
+            indexer.shutdown()
+            cluster.close()
